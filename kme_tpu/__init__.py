@@ -1,0 +1,38 @@
+"""kme_tpu — TPU-native matching-engine framework.
+
+A ground-up JAX/XLA/Pallas/pjit re-design of the capabilities of the
+reference VD44/Kafka-Matching-Engine (a Kafka Streams limit-order-book
+processor, /root/reference/src/main/java/KProcessor.java): prediction-market
+style binary-outcome contracts, integer prices 0..125, margin `price` per
+unit for buys and `100 - price` per unit for sells
+(KProcessor.java:167-182), account ledgers, pre-trade risk checks,
+price-time-priority matching, cancels, and symbol settlement.
+
+Instead of one message at a time against five RocksDB stores, this framework
+keeps the entire exchange state resident in dense device arrays (HBM),
+processes conflict-free micro-batch steps with `lax.scan` (serial in time,
+parallel across symbols via `vmap`), and shards the symbol axis over a TPU
+mesh with `shard_map`, merging cross-shard account-balance deltas with exact
+integer `psum` collectives over ICI.
+
+Package layout:
+  oracle/    quirk-faithful pure-Python replica of the reference semantics
+             (the golden parity judge; compat='java' and compat='fixed')
+  models/    the assembled engine models (batched device engine + host session)
+  ops/       device kernels: lane step (risk/match/insert/cancel), Pallas
+             matcher, exact bit/codec utilities
+  parallel/  mesh construction, sharding specs, collectives
+  runtime/   native C++ host runtime (wire parse, oid index, scheduler,
+             event decode) with a pure-Python fallback
+  bridge/    transport edge speaking the reference's Kafka wire contract
+  utils/     events, snapshots, metrics, profiling
+
+The top-level package is import-light: the pure-Python layers (wire,
+oracle, workload, config) work without JAX. Device modules (models/, ops/,
+parallel/) import `kme_tpu._jaxsetup` which enables x64 once.
+"""
+
+__version__ = "0.1.0"
+
+from kme_tpu.config import EngineConfig  # noqa: F401
+from kme_tpu import opcodes  # noqa: F401

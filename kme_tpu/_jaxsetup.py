@@ -1,0 +1,13 @@
+"""One-time JAX configuration for the device-side modules.
+
+int64 is part of the engine's data model (Java `long` balances/ids,
+KProcessor.java:30-33, 451-455). JAX downcasts to int32 unless x64 is
+enabled; device modules import this module before touching jax.numpy.
+The hot matching path still uses explicit int32 arrays — only ledger
+arithmetic is 64-bit. Pure-Python layers (wire/oracle/workload) do not
+import this, so they stay usable without JAX.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

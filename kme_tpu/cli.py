@@ -1,0 +1,116 @@
+"""Command-line entry points.
+
+The reference splits its operational surface across three Node scripts and
+a JVM main (topic.js / exchange_test.js / consumer.js / KProcessor.main,
+README.md:10-30); here each role is one subcommand over a shared config.
+
+Commands grow as the framework does; anything not yet wired reports
+itself clearly instead of half-working.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _not_yet(what: str) -> "int":
+    print(f"kme_tpu: {what} is not wired up yet in this build", file=sys.stderr)
+    return 2
+
+
+def loadgen_main(argv=None) -> int:
+    """Workload generator — the exchange_test.js role: emit a seeded wire
+    stream (JSON lines) to stdout or a transport."""
+    p = argparse.ArgumentParser(prog="kme-loadgen", description=loadgen_main.__doc__)
+    p.add_argument("--events", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--accounts", type=int, default=10)
+    p.add_argument("--symbols", type=int, default=3)
+    p.add_argument("--validate", action="store_true",
+                   help="clamp prices/sizes to the fixed-mode domain")
+    p.add_argument("--fix-payout-opcode", action="store_true",
+                   help="emit real PAYOUT (200) instead of the reference "
+                        "harness's action=4 bug (Q5)")
+    args = p.parse_args(argv)
+    from kme_tpu.wire import dumps_order
+    from kme_tpu.workload import harness_stream
+
+    for m in harness_stream(args.events, seed=args.seed,
+                            num_accounts=args.accounts,
+                            num_symbols=args.symbols,
+                            payout_opcode_bug=not args.fix_payout_opcode,
+                            validate=args.validate):
+        print(dumps_order(m))
+    return 0
+
+
+def oracle_main(argv=None) -> int:
+    """Reference-replica engine over stdin/stdout: read order JSON lines,
+    print the 'IN {...}' / 'OUT {...}' stream consumer.js would show."""
+    p = argparse.ArgumentParser(prog="kme-oracle", description=oracle_main.__doc__)
+    p.add_argument("--compat", choices=("java", "fixed"), default="java")
+    args = p.parse_args(argv)
+    from kme_tpu.oracle import OracleEngine
+    from kme_tpu.wire import parse_order
+
+    eng = OracleEngine(args.compat)
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        for rec in eng.process(parse_order(line)):
+            print(rec.wire())
+    return 0
+
+
+def bench_main(argv=None) -> int:
+    """Benchmark harness (bench.py at the repo root drives the same code)."""
+    try:
+        from kme_tpu.benchmarks import main as _main
+    except ImportError:
+        return _not_yet("the benchmark suite")
+    return _main(argv)
+
+
+def serve_main(argv=None) -> int:
+    """Engine service speaking the reference Kafka wire contract."""
+    try:
+        from kme_tpu.bridge.serve import main as _main
+    except ImportError:
+        return _not_yet("the transport bridge")
+    return _main(argv)
+
+
+def consume_main(argv=None) -> int:
+    """Fill-stream consumer — the consumer.js role."""
+    try:
+        from kme_tpu.bridge.consume import main as _main
+    except ImportError:
+        return _not_yet("the transport bridge")
+    return _main(argv)
+
+
+def provision_main(argv=None) -> int:
+    """Topic provisioner — the topic.js role."""
+    try:
+        from kme_tpu.bridge.provision import main as _main
+    except ImportError:
+        return _not_yet("the transport bridge")
+    return _main(argv)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m kme_tpu.cli")
+    p.add_argument("command", choices=(
+        "loadgen", "oracle", "bench", "serve", "consume", "provision"))
+    args, rest = p.parse_known_args(argv)
+    return {
+        "loadgen": loadgen_main, "oracle": oracle_main, "bench": bench_main,
+        "serve": serve_main, "consume": consume_main,
+        "provision": provision_main,
+    }[args.command](rest)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

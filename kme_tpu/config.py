@@ -1,0 +1,85 @@
+"""Engine configuration.
+
+The reference hardcodes all of its knobs (broker address and app id in
+KProcessor.java:24-29, topic names topic.js:17-21, workload shape
+exchange_test.js:18-20). Here every capacity and mode is one dataclass,
+used by the host runtime, the device engine, and the CLIs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+# The reference's price domain: 126 levels, 0..125, packed as two 63-bit
+# halves of a UUID bitmap (KProcessor.java:391-394 splits at price < 63;
+# bit 63 of the LSB long is unused — quirk Q8). We keep the same domain.
+PRICE_LEVELS = 126
+
+# Margin model (KProcessor.java:176): buys reserve `price` per unit, sells
+# reserve `100 - price` per unit; PAYOUT settles at `100 - rake` per long
+# contract (exchange_test.js:76-79).
+SETTLE_BASE = 100
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static shape + semantics configuration for one engine instance."""
+
+    # Capacity (static shapes — XLA compiles one program per config)
+    num_symbols: int = 8          # S: symbol lanes (sharded axis)
+    num_accounts: int = 64        # A: dense account capacity
+    book_capacity: int = 128      # CAP: resting-order slots per book side
+    steps_per_batch: int = 32     # T: lax.scan steps per device dispatch
+    max_trades_per_op: int = 32   # E: fill-event buffer slots per op
+
+    # Semantics: 'java' replicates the reference byte-for-byte including its
+    # quirk ledger (SURVEY.md §2.5 Q1..Q10); 'fixed' is the corrected mode
+    # (separate sid=0 books, correct crossing guard, working
+    # REMOVE_SYMBOL/PAYOUT, input validation).
+    compat: str = "java"
+
+    # Parallelism: number of mesh shards over the symbol axis. 1 = single
+    # device. num_symbols must be divisible by mesh_shards.
+    mesh_shards: int = 1
+
+    # Use the Pallas TPU kernel for the per-lane match/insert scan instead
+    # of the pure-XLA lowering (ops/match_pallas.py).
+    use_pallas: bool = False
+
+    def __post_init__(self) -> None:
+        if self.compat not in ("java", "fixed"):
+            raise ValueError(f"compat must be 'java' or 'fixed', got {self.compat!r}")
+        if self.num_symbols % self.mesh_shards != 0:
+            raise ValueError(
+                f"num_symbols={self.num_symbols} not divisible by "
+                f"mesh_shards={self.mesh_shards}"
+            )
+        for field in ("num_symbols", "num_accounts", "book_capacity",
+                      "steps_per_batch", "max_trades_per_op"):
+            if getattr(self, field) <= 0:
+                raise ValueError(f"{field} must be positive")
+
+    @property
+    def java_compat(self) -> bool:
+        return self.compat == "java"
+
+    @property
+    def symbols_per_shard(self) -> int:
+        return self.num_symbols // self.mesh_shards
+
+    def validate_for_workload(self, num_symbols: int, num_accounts: int) -> None:
+        if num_symbols > self.num_symbols:
+            raise ValueError(
+                f"workload uses {num_symbols} symbols, config capacity "
+                f"is {self.num_symbols}")
+        if num_accounts > self.num_accounts:
+            raise ValueError(
+                f"workload uses {num_accounts} accounts, config capacity "
+                f"is {self.num_accounts}")
+
+
+def round_up_pow2(n: int) -> int:
+    """Smallest power of two >= n (exact integer math — float log2 rounds
+    down for n just above a large power of two)."""
+    return 1 << max(1, n - 1).bit_length() if n > 1 else 1

@@ -1,0 +1,108 @@
+"""Wire schema: the reference's JSON Order message, byte-compatible.
+
+The reference's serde is Jackson over a POJO with public fields declared in
+the order action, oid, aid, sid, price, size, next, prev
+(/root/reference/src/main/java/KProcessor.java:448-475), serialized with
+`writeValueAsString(...).getBytes()` (KProcessor.java:488-490): compact JSON
+(no spaces), fields in declaration order, `next`/`prev` always present
+(null when unset — quirk Q9: the intrusive list pointers leak onto the
+wire). Incoming messages are parsed by field name; missing fields default
+to 0 / null (Jackson primitive defaults). Note Jackson binds `next`/`prev`
+FROM input too — the @JsonCreator ctor covers the six value fields, and
+the remaining public fields are bound by field access afterward — so a
+message carrying non-null pointers (e.g. a replayed OUT echo) enters the
+engine with them set, and a new-bucket rest stores them verbatim (only the
+append path overwrites `prev`, KProcessor.java:217). Parsed faithfully
+here; the device engine's compat envelope excludes such inputs (COMPAT.md).
+
+`dumps_order` reproduces the exact byte stream so the reference's
+consumer.js output is byte-identical under our engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterator, Optional
+
+_FIELDS = ("action", "oid", "aid", "sid", "price", "size")
+
+
+@dataclasses.dataclass
+class OrderMsg:
+    """One wire message. Mirrors the reference Order POJO
+    (KProcessor.java:448-475)."""
+
+    action: int = 0
+    oid: int = 0
+    aid: int = 0
+    sid: int = 0
+    price: int = 0
+    size: int = 0
+    next: Optional[int] = None
+    prev: Optional[int] = None
+
+    def copy(self) -> "OrderMsg":
+        return dataclasses.replace(self)
+
+
+def parse_order(data: bytes | str) -> OrderMsg:
+    """Parse an input JSON message the way Jackson does on the reference
+    POJO (KProcessor.java:448-475): creator-bound value fields default to
+    0 when absent; the public `next`/`prev` fields are bound by name when
+    present (null/absent -> None)."""
+    obj = json.loads(data)
+    if not isinstance(obj, dict):
+        raise ValueError(f"order message must be a JSON object, got {type(obj)}")
+    kw = {}
+    for f in _FIELDS:
+        v = obj.get(f, 0)
+        if v is None:
+            v = 0
+        kw[f] = _as_int(f, v)
+    msg = OrderMsg(**kw)
+    for f in ("next", "prev"):
+        v = obj.get(f)
+        if v is not None:
+            setattr(msg, f, _as_int(f, v))
+    return msg
+
+
+def _as_int(field: str, v) -> int:
+    if not isinstance(v, int) or isinstance(v, bool):
+        # Jackson would coerce or throw; we accept exact ints only
+        # (floats with integral value are coerced like Jackson does).
+        if isinstance(v, float) and v.is_integer():
+            return int(v)
+        raise ValueError(f"field {field!r} must be an integer, got {v!r}")
+    return v
+
+
+def dumps_order(o: OrderMsg) -> str:
+    """Serialize exactly like Jackson on the reference POJO: compact,
+    declaration field order, next/prev always present (KProcessor.java:488)."""
+    nxt = "null" if o.next is None else str(o.next)
+    prv = "null" if o.prev is None else str(o.prev)
+    return (
+        f'{{"action":{o.action},"oid":{o.oid},"aid":{o.aid},"sid":{o.sid},'
+        f'"price":{o.price},"size":{o.size},"next":{nxt},"prev":{prv}}}'
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class OutRecord:
+    """One record on the output stream: key is "IN" (pre-processing echo,
+    KProcessor.java:97) or "OUT" (result echo / fill event,
+    KProcessor.java:124, 272-273)."""
+
+    key: str
+    value: OrderMsg
+
+    def wire(self) -> str:
+        """The `<key> <value>` line consumer.js:19 prints."""
+        return f"{self.key} {dumps_order(self.value)}"
+
+
+def wire_lines(records: Iterator[OutRecord]) -> Iterator[str]:
+    for r in records:
+        yield r.wire()

@@ -1,0 +1,26 @@
+"""Test environment: force an 8-device virtual CPU mesh before JAX import.
+
+The idiomatic JAX answer to "test distributed without a cluster"
+(SURVEY.md §4): XLA's host platform is told to expose 8 devices, and every
+sharding test runs over a real Mesh on them. The real-TPU bench path is
+exercised separately by bench.py / the driver.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

@@ -1,0 +1,83 @@
+"""Exact Java arithmetic + the Q7 bit-scan equivalence proof
+(SURVEY.md §2.5 Q7: replicate float scans with integer ops only after
+confirming equivalence on the used range)."""
+
+import random
+
+from kme_tpu.oracle import javalong as jl
+
+
+def test_jlong_wrap():
+    assert jl.jlong(2 ** 63) == -(2 ** 63)
+    assert jl.jlong(2 ** 64) == 0
+    assert jl.jlong(-(2 ** 63) - 1) == 2 ** 63 - 1
+    assert jl.jlong(5) == 5
+    assert jl.jadd(2 ** 63 - 1, 1) == -(2 ** 63)
+    assert jl.jmul(2 ** 32, 2 ** 32) == 0
+
+
+def test_jint_wrap():
+    assert jl.jint(2 ** 31) == -(2 ** 31)
+    assert jl.jint(-(2 ** 31) - 1) == 2 ** 31 - 1
+
+
+def test_java_shift_masks_count():
+    # Java masks long shift counts to 6 bits: n << 64 == n
+    assert jl.jshl(1, 64) == 1
+    assert jl.jshl(1, 65) == 2
+    assert jl.jshl(1, -3) == jl.jshl(1, 61)
+    assert jl.jshr(-1, 63) == -1  # arithmetic shift
+
+
+def test_bit_ops_match_java():
+    assert jl.set_bit(0, 5) == 32
+    assert jl.unset_bit(33, 5) == 1
+    assert jl.get_bit(33, 5)
+    assert not jl.get_bit(33, 4)
+    # negative k: Java masks to 6 bits
+    assert jl.set_bit(0, -1) == jl.set_bit(0, 63)
+
+
+def test_float_bitscan_equivalence_first():
+    """getFirstSetBitPos (KProcessor.java:371-373) operates on n & -n, an
+    exact power of two: the float formula is exact for every bit 0..62 —
+    so the device engine's integer count-trailing-zeros is equivalent on
+    the entire book-bitmap domain."""
+    for k in range(63):
+        assert jl.first_set_bit_pos_float(1 << k) == k == jl.first_set_bit_pos(1 << k)
+    rng = random.Random(1)
+    for _ in range(50_000):
+        n = rng.getrandbits(63)
+        if n == 0:
+            continue
+        assert jl.first_set_bit_pos_float(n) == jl.first_set_bit_pos(n)
+
+
+def test_float_bitscan_last_overshoot_domain():
+    """getLastSetBitPos (KProcessor.java:375-377) is exact for every
+    single-bit value and for all values below 2^47, but overshoots by one
+    on dense values with top bit >= 47 (log10 ratio rounds up to the next
+    integer). In the reference that overshoot makes getMaxPriceBucketPointer
+    return a price with no bucket -> NPE -> engine crash. The oracle
+    reproduces the float semantics (raising ReferenceCrash); the device
+    engine uses the exact integer scan, which only diverges where the
+    reference self-destructs."""
+    for k in range(63):
+        assert jl.last_set_bit_pos_float(1 << k) == k == jl.last_set_bit_pos(1 << k)
+    # documented overshoot: 2^48 - 1 (bits 0..47 all set)
+    assert jl.last_set_bit_pos(2 ** 48 - 1) == 47
+    assert jl.last_set_bit_pos_float(2 ** 48 - 1) == 48
+    # exactness below the overshoot domain
+    rng = random.Random(2)
+    for _ in range(50_000):
+        n = rng.getrandbits(46)
+        if n == 0:
+            continue
+        assert jl.last_set_bit_pos_float(n) == jl.last_set_bit_pos(n)
+
+
+def test_bitscan_zero_and_negative_edges():
+    # Java: (int)(-Infinity) == Integer.MIN_VALUE; (int)NaN == 0
+    assert jl.last_set_bit_pos_float(0) == -(1 << 31)
+    assert jl.last_set_bit_pos_float(-5) == 0
+    assert jl.first_set_bit_pos_float(0) == -(1 << 31)

@@ -1,0 +1,432 @@
+"""Scenario tests for the golden oracle, pinning the reference semantics
+(KProcessor.java:63-445) including the quirk ledger (SURVEY.md §2.5)."""
+
+import pytest
+
+from kme_tpu import opcodes as op
+from kme_tpu.oracle import OracleEngine, ReferenceHang
+from kme_tpu.wire import OrderMsg
+
+
+def eng(compat="java"):
+    return OracleEngine(compat)
+
+
+def msg(action, oid=0, aid=0, sid=0, price=0, size=0):
+    return OrderMsg(action=action, oid=oid, aid=aid, sid=sid, price=price, size=size)
+
+
+def seed(e, accounts=(0, 1, 2), deposit=100_000, symbols=(1,)):
+    for a in accounts:
+        e.process(msg(op.CREATE_BALANCE, aid=a))
+        e.process(msg(op.TRANSFER, aid=a, size=deposit))
+    for s in symbols:
+        e.process(msg(op.ADD_SYMBOL, sid=s))
+
+
+def out_actions(records):
+    return [(r.key, r.value.action) for r in records]
+
+
+# ---------------------------------------------------------------- ledger
+
+def test_create_balance_idempotent():
+    e = eng()
+    r1 = e.process(msg(op.CREATE_BALANCE, aid=5))
+    assert out_actions(r1) == [("IN", 100), ("OUT", 100)]
+    r2 = e.process(msg(op.CREATE_BALANCE, aid=5))
+    assert out_actions(r2) == [("IN", 100), ("OUT", op.REJECT)]
+    assert e.balances[5] == 0
+
+
+def test_transfer_guard():
+    e = eng()
+    e.process(msg(op.CREATE_BALANCE, aid=1))
+    assert e.process(msg(op.TRANSFER, aid=1, size=50))[-1].value.action == op.TRANSFER
+    # withdraw exactly to zero allowed: balance < -size is 50 < 50 -> false
+    assert e.process(msg(op.TRANSFER, aid=1, size=-50))[-1].value.action == op.TRANSFER
+    assert e.balances[1] == 0
+    # overdraw rejected
+    assert e.process(msg(op.TRANSFER, aid=1, size=-1))[-1].value.action == op.REJECT
+    # unknown account rejected
+    assert e.process(msg(op.TRANSFER, aid=9, size=5))[-1].value.action == op.REJECT
+
+
+# ---------------------------------------------------------------- margin
+
+def test_buy_margin_debit():
+    e = eng()
+    seed(e, accounts=(0,))
+    e.process(msg(op.BUY, oid=1, aid=0, sid=1, price=60, size=10))
+    assert e.balances[0] == 100_000 - 600
+
+
+def test_sell_margin_debit():
+    e = eng()
+    seed(e, accounts=(0,))
+    e.process(msg(op.SELL, oid=1, aid=0, sid=1, price=60, size=10))
+    # sells reserve (100 - price) per unit (KProcessor.java:176)
+    assert e.balances[0] == 100_000 - 400
+
+
+def test_insufficient_balance_rejects():
+    e = eng()
+    seed(e, accounts=(0,), deposit=100)
+    r = e.process(msg(op.BUY, oid=1, aid=0, sid=1, price=60, size=10))
+    assert r[-1].value.action == op.REJECT
+    assert e.balances[0] == 100
+
+
+def test_missing_book_rejects():
+    e = eng()
+    seed(e, accounts=(0,), symbols=())
+    r = e.process(msg(op.BUY, oid=1, aid=0, sid=1, price=60, size=10))
+    assert r[-1].value.action == op.REJECT
+
+
+def test_netting_closing_trade_needs_no_margin():
+    e = eng()
+    seed(e)
+    # account 0 ends long 10 via a trade with account 1
+    e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=50, size=10))
+    e.process(msg(op.BUY, oid=2, aid=0, sid=1, price=50, size=10))
+    assert e.positions[(0, 1)] == (10, 10)
+    bal_before = e.balances[0]
+    # selling against a long available position reserves nothing
+    e.process(msg(op.SELL, oid=3, aid=0, sid=1, price=40, size=10))
+    assert e.balances[0] == bal_before
+    # the long 'available' is now blocked
+    assert e.positions[(0, 1)] == (10, 0)
+
+
+# ---------------------------------------------------------------- matching
+
+def test_simple_full_match():
+    e = eng()
+    seed(e)
+    e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=50, size=10))
+    r = e.process(msg(op.BUY, oid=2, aid=0, sid=1, price=55, size=10))
+    # IN echo, maker fill (SOLD, price 0), taker fill (BOUGHT, improvement 5), OUT echo
+    assert out_actions(r) == [
+        ("IN", op.BUY), ("OUT", op.SOLD), ("OUT", op.BOUGHT), ("OUT", op.BUY)]
+    maker_fill, taker_fill = r[1].value, r[2].value
+    assert (maker_fill.oid, maker_fill.price, maker_fill.size) == (1, 0, 10)
+    assert (taker_fill.oid, taker_fill.price, taker_fill.size) == (2, 5, 10)
+    # OUT echo has residual size 0 (Q9)
+    assert r[3].value.size == 0
+    # positions: maker short, taker long
+    assert e.positions[(1, 1)] == (-10, -10)
+    assert e.positions[(0, 1)] == (10, 10)
+    # taker paid maker's price: 55*10 reserved, 5*10 credited back
+    assert e.balances[0] == 100_000 - 500
+    assert e.balances[1] == 100_000 - 500
+
+
+def test_partial_fill_rests_remainder():
+    e = eng()
+    seed(e)
+    e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=50, size=4))
+    r = e.process(msg(op.BUY, oid=2, aid=0, sid=1, price=55, size=10))
+    assert r[-1].value.size == 6  # residual rested (Q9 echo)
+    assert e.orders[2].size == 6
+    # maker gone
+    assert 1 not in e.orders
+
+
+def test_price_priority_walks_levels():
+    e = eng()
+    seed(e)
+    e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=52, size=5))
+    e.process(msg(op.SELL, oid=2, aid=2, sid=1, price=50, size=5))
+    r = e.process(msg(op.BUY, oid=3, aid=0, sid=1, price=55, size=10))
+    fills = [rec.value for rec in r if rec.value.action in (op.BOUGHT, op.SOLD)]
+    # best price (50, oid 2) trades first, then 52
+    assert [f.oid for f in fills] == [2, 3, 1, 3]
+    assert [f.price for f in fills] == [0, 5, 0, 3]
+
+
+def test_time_priority_fifo_within_level():
+    e = eng()
+    seed(e)
+    e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=50, size=5))
+    e.process(msg(op.SELL, oid=2, aid=2, sid=1, price=50, size=5))
+    r = e.process(msg(op.BUY, oid=3, aid=0, sid=1, price=50, size=7))
+    fills = [rec.value for rec in r if rec.value.action == op.SOLD]
+    assert [f.oid for f in fills] == [1, 2]
+    assert [f.size for f in fills] == [5, 2]
+    # oid 2 remains with 3 left, still head of its bucket
+    assert e.orders[2].size == 3
+
+
+def test_non_crossing_rests():
+    e = eng()
+    seed(e)
+    e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=60, size=5))
+    r = e.process(msg(op.BUY, oid=2, aid=0, sid=1, price=55, size=5))
+    assert out_actions(r) == [("IN", op.BUY), ("OUT", op.BUY)]
+    assert e.orders[2].size == 5
+
+
+def test_q9_prev_pointer_leaks_in_echo():
+    e = eng()
+    seed(e)
+    e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=60, size=5))
+    r = e.process(msg(op.SELL, oid=2, aid=2, sid=1, price=60, size=5))
+    assert r[-1].value.prev == 1
+    assert r[-1].value.next is None
+
+
+def test_q2_sell_taker_ghost_trade():
+    """Q2: a sell taker that exactly exhausts a maker performs one extra
+    zero-size trade with the next still-crossing maker."""
+    e = eng()
+    seed(e)
+    e.process(msg(op.BUY, oid=1, aid=1, sid=1, price=50, size=5))
+    e.process(msg(op.BUY, oid=2, aid=2, sid=1, price=50, size=5))
+    r = e.process(msg(op.SELL, oid=3, aid=0, sid=1, price=50, size=5))
+    fills = [rec.value for rec in r if rec.value.action in (op.BOUGHT, op.SOLD)]
+    # real fill with oid 1, then ghost zero-size fill pair with oid 2
+    assert [(f.oid, f.size) for f in fills] == [(1, 5), (3, 5), (2, 0), (3, 0)]
+    # fixed mode: no ghost
+    e2 = eng("fixed")
+    seed(e2)
+    e2.process(msg(op.BUY, oid=1, aid=1, sid=1, price=50, size=5))
+    e2.process(msg(op.BUY, oid=2, aid=2, sid=1, price=50, size=5))
+    r2 = e2.process(msg(op.SELL, oid=3, aid=0, sid=1, price=50, size=5))
+    fills2 = [rec.value for rec in r2 if rec.value.action in (op.BOUGHT, op.SOLD)]
+    assert [(f.oid, f.size) for f in fills2] == [(1, 5), (3, 5)]
+
+
+def test_q2_zero_size_buy_ghost_trade_against_non_crossing_ask():
+    """Q2: a zero-size buy evaluates the sell-side comparison, producing a
+    spurious zero-size trade against a NON-crossing ask."""
+    e = eng()
+    seed(e)
+    e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=60, size=5))
+    r = e.process(msg(op.BUY, oid=2, aid=0, sid=1, price=50, size=0))
+    fills = [rec.value for rec in r if rec.value.action in (op.BOUGHT, op.SOLD)]
+    assert [(f.oid, f.size) for f in fills] == [(1, 0), (2, 0)]
+    assert r[-1].value.action == op.BUY  # "matched" (size==0 -> true)
+
+
+def test_q1_sid0_merged_book_buys_match_buys():
+    """Q1: symbol 0's buy and sell sides share one book (-0 == 0): a
+    crossing buy matches a RESTING BUY."""
+    e = eng()
+    seed(e, symbols=(0,))
+    e.process(msg(op.BUY, oid=1, aid=1, sid=0, price=50, size=5))
+    r = e.process(msg(op.BUY, oid=2, aid=0, sid=0, price=50, size=5))
+    fills = [rec.value for rec in r if rec.value.action in (op.BOUGHT, op.SOLD)]
+    assert [(f.action, f.oid) for f in fills] == [(op.SOLD, 1), (op.BOUGHT, 2)]
+    # fixed mode: sides are disjoint, the second buy rests
+    e2 = eng("fixed")
+    seed(e2, symbols=(0,))
+    e2.process(msg(op.BUY, oid=1, aid=1, sid=0, price=50, size=5))
+    r2 = e2.process(msg(op.BUY, oid=2, aid=0, sid=0, price=50, size=5))
+    assert out_actions(r2) == [("IN", op.BUY), ("OUT", op.BUY)]
+    assert e2.orders[1].size == 5 and e2.orders[2].size == 5
+
+
+# ---------------------------------------------------------------- cancel
+
+def test_cancel_refunds_margin():
+    e = eng()
+    seed(e, accounts=(0,))
+    e.process(msg(op.BUY, oid=1, aid=0, sid=1, price=60, size=10))
+    assert e.balances[0] == 100_000 - 600
+    r = e.process(msg(op.CANCEL, oid=1, aid=0))
+    assert r[-1].value.action == op.CANCEL
+    assert e.balances[0] == 100_000
+    assert 1 not in e.orders
+
+
+def test_cancel_auth_and_unknown():
+    e = eng()
+    seed(e)
+    e.process(msg(op.BUY, oid=1, aid=0, sid=1, price=60, size=10))
+    assert e.process(msg(op.CANCEL, oid=1, aid=2))[-1].value.action == op.REJECT
+    assert e.process(msg(op.CANCEL, oid=99, aid=0))[-1].value.action == op.REJECT
+
+
+def test_cancel_middle_preserves_fifo():
+    e = eng()
+    seed(e)
+    for i, a in ((1, 0), (2, 1), (3, 2)):
+        e.process(msg(op.SELL, oid=i, aid=a, sid=1, price=50, size=5))
+    e.process(msg(op.CANCEL, oid=2, aid=1))
+    r = e.process(msg(op.BUY, oid=4, aid=0, sid=1, price=50, size=10))
+    fills = [rec.value for rec in r if rec.value.action == op.SOLD]
+    assert [f.oid for f in fills] == [1, 3]
+
+
+def test_cancel_head_and_tail():
+    e = eng()
+    seed(e)
+    for i, a in ((1, 0), (2, 1), (3, 2)):
+        e.process(msg(op.SELL, oid=i, aid=a, sid=1, price=50, size=5))
+    e.process(msg(op.CANCEL, oid=1, aid=0))
+    e.process(msg(op.CANCEL, oid=3, aid=2))
+    r = e.process(msg(op.BUY, oid=4, aid=0, sid=1, price=55, size=10))
+    fills = [rec.value for rec in r if rec.value.action == op.SOLD]
+    assert [f.oid for f in fills] == [2]
+    assert e.orders[4].size == 5  # remainder rested
+
+
+def test_cancel_released_margin_reblocks_netted_position():
+    """postRemoveAdjustments' adj mirrors checkBalance's netting. In java
+    mode the adj-write lands on a garbage key (Q11,
+    KProcessor.java:332); fixed mode restores the real position."""
+    for compat in ("java", "fixed"):
+        e = eng(compat)
+        seed(e)
+        e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=50, size=10))
+        e.process(msg(op.BUY, oid=2, aid=0, sid=1, price=50, size=10))
+        # account 0 long 10 available; sell 10 against it (no margin), cancel
+        e.process(msg(op.SELL, oid=3, aid=0, sid=1, price=40, size=10))
+        bal = e.balances[0]
+        e.process(msg(op.CANCEL, oid=3, aid=0))
+        assert e.balances[0] == bal  # nothing was reserved, nothing refunded
+        if compat == "fixed":
+            assert e.positions[(0, 1)] == (10, 10)  # available restored
+        else:
+            # Q11: real key keeps the blocked state; garbage key (10, 0)
+            # receives the "restored" value
+            assert e.positions[(0, 1)] == (10, 0)
+            assert e.positions[(10, 0)] == (10, 10)
+
+
+def test_q11_second_fill_writes_garbage_key():
+    """Q11: fillOrder's update branch keys the store by the position VALUE
+    (KProcessor.java:283-284): the real (aid, sid) entry keeps its
+    first-fill value forever; accumulation lands on garbage keys."""
+    e = eng()
+    seed(e)
+    e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=50, size=5))
+    e.process(msg(op.BUY, oid=2, aid=0, sid=1, price=50, size=5))
+    assert e.positions[(0, 1)] == (5, 5)
+    assert e.positions[(1, 1)] == (-5, -5)
+    e.process(msg(op.SELL, oid=3, aid=1, sid=1, price=50, size=5))
+    e.process(msg(op.BUY, oid=4, aid=0, sid=1, price=50, size=5))
+    # java: real keys unchanged, garbage keys hold the accumulation
+    assert e.positions[(0, 1)] == (5, 5)
+    assert e.positions[(5, 5)] == (10, 10)
+    assert e.positions[(1, 1)] == (-5, -5)
+    assert e.positions[(-5, -5)] == (-10, -10)
+    # fixed: real keys accumulate, no garbage
+    e2 = eng("fixed")
+    seed(e2)
+    for i, (act, aid) in enumerate(
+            [(op.SELL, 1), (op.BUY, 0), (op.SELL, 1), (op.BUY, 0)], start=1):
+        e2.process(msg(act, oid=i, aid=aid, sid=1, price=50, size=5))
+    assert e2.positions[(0, 1)] == (10, 10)
+    assert e2.positions[(1, 1)] == (-10, -10)
+    assert (5, 5) not in e2.positions
+
+
+# ------------------------------------------------------- symbol lifecycle
+
+def test_add_symbol_duplicate_rejects():
+    e = eng()
+    assert e.process(msg(op.ADD_SYMBOL, sid=2))[-1].value.action == op.ADD_SYMBOL
+    assert e.process(msg(op.ADD_SYMBOL, sid=2))[-1].value.action == op.REJECT
+
+
+def test_q3_remove_symbol_inverted():
+    e = eng()
+    e.process(msg(op.ADD_SYMBOL, sid=2))
+    # empty books exist -> removeAllOrders true -> removeSymbol FALSE -> REJECT
+    r = e.process(msg(op.REMOVE_SYMBOL, sid=2))
+    assert r[-1].value.action == op.REJECT
+    assert 2 in e.books
+    # symbol that never existed -> "succeeds"
+    r2 = e.process(msg(op.REMOVE_SYMBOL, sid=9))
+    assert r2[-1].value.action == op.REMOVE_SYMBOL
+
+
+def test_q4_remove_symbol_nonempty_hangs():
+    e = eng()
+    seed(e)
+    e.process(msg(op.BUY, oid=1, aid=0, sid=1, price=50, size=5))
+    with pytest.raises(ReferenceHang):
+        e.process(msg(op.REMOVE_SYMBOL, sid=1))
+
+
+def test_fixed_remove_symbol_wipes_and_refunds():
+    e = eng("fixed")
+    seed(e)
+    e.process(msg(op.BUY, oid=1, aid=0, sid=1, price=60, size=10))
+    e.process(msg(op.SELL, oid=2, aid=1, sid=1, price=70, size=10))
+    r = e.process(msg(op.REMOVE_SYMBOL, sid=1))
+    assert r[-1].value.action == op.REMOVE_SYMBOL
+    assert e.balances[0] == 100_000 and e.balances[1] == 100_000
+    assert not e.orders and not e.buckets
+    assert 2 not in e.books and 3 not in e.books
+
+
+# ---------------------------------------------------------------- payout
+
+def test_q5_q6_payout_always_rejects_in_java_mode():
+    e = eng()
+    seed(e)
+    r = e.process(msg(op.PAYOUT, sid=1, size=97))
+    assert r[-1].value.action == op.REJECT  # result ignored (Q6)
+    # books untouched (removeAllOrders on empty book short-circuits)
+    assert 1 in e.books and -1 in e.books
+
+
+def test_fixed_payout_yes_resolution():
+    e = eng("fixed")
+    seed(e)
+    e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=50, size=10))
+    e.process(msg(op.BUY, oid=2, aid=0, sid=1, price=50, size=10))
+    r = e.process(msg(op.PAYOUT, sid=1, size=97))
+    assert r[-1].value.action == op.PAYOUT
+    # long credited 97*10, short debited 97*10
+    assert e.balances[0] == 100_000 - 500 + 970
+    assert e.balances[1] == 100_000 - 500 - 970
+    assert (0, 1) not in e.positions and (1, 1) not in e.positions
+    assert 2 not in e.books
+
+
+def test_fixed_payout_no_resolution():
+    e = eng("fixed")
+    seed(e)
+    e.process(msg(op.SELL, oid=1, aid=1, sid=1, price=50, size=10))
+    e.process(msg(op.BUY, oid=2, aid=0, sid=1, price=50, size=10))
+    r = e.process(msg(op.PAYOUT, sid=-1, size=97))
+    assert r[-1].value.action == op.PAYOUT
+    assert e.balances[0] == 100_000 - 500
+    assert e.balances[1] == 100_000 - 500
+    assert (0, 1) not in e.positions
+    assert e.process(msg(op.PAYOUT, sid=1, size=97))[-1].value.action == op.REJECT
+
+
+def test_fixed_payout_refunds_resting_margin():
+    e = eng("fixed")
+    seed(e)
+    e.process(msg(op.BUY, oid=1, aid=0, sid=1, price=60, size=10))
+    e.process(msg(op.PAYOUT, sid=1, size=97))
+    assert e.balances[0] == 100_000  # margin released on wipe
+
+
+# ----------------------------------------------------- fixed validation
+
+def test_fixed_mode_validation():
+    e = eng("fixed")
+    seed(e)
+    assert e.process(msg(op.BUY, oid=1, aid=0, sid=1, price=126, size=5)
+                     )[-1].value.action == op.REJECT
+    assert e.process(msg(op.BUY, oid=2, aid=0, sid=1, price=-1, size=5)
+                     )[-1].value.action == op.REJECT
+    assert e.process(msg(op.BUY, oid=3, aid=0, sid=1, price=50, size=0)
+                     )[-1].value.action == op.REJECT
+    assert e.process(msg(op.SELL, oid=4, aid=0, sid=1, price=125, size=1)
+                     )[-1].value.action == op.SELL
+
+
+# ----------------------------------------------------- unknown opcodes
+
+def test_unknown_action_rejects():
+    e = eng()
+    assert e.process(msg(op.BOUGHT, aid=0))[-1].value.action == op.REJECT
+    assert e.process(msg(42))[-1].value.action == op.REJECT

@@ -1,0 +1,57 @@
+"""Wire serde byte-parity with the reference's Jackson stack
+(KProcessor.java:477-530)."""
+
+import pytest
+
+from kme_tpu.wire import OrderMsg, OutRecord, dumps_order, parse_order
+
+
+def test_dumps_matches_jackson_layout():
+    o = OrderMsg(action=2, oid=123, aid=4, sid=1, price=50, size=10)
+    assert dumps_order(o) == (
+        '{"action":2,"oid":123,"aid":4,"sid":1,"price":50,"size":10,'
+        '"next":null,"prev":null}')
+
+
+def test_dumps_with_prev_set():
+    o = OrderMsg(action=2, oid=9, aid=1, sid=0, price=50, size=3, prev=77)
+    assert dumps_order(o).endswith('"next":null,"prev":77}')
+
+
+def test_parse_defaults_missing_fields():
+    o = parse_order('{"action":100,"aid":7}')
+    assert (o.action, o.oid, o.aid, o.sid, o.price, o.size) == (100, 0, 7, 0, 0, 0)
+    assert o.next is None and o.prev is None
+
+
+def test_parse_binds_input_pointers():
+    # Jackson binds the public next/prev fields from input when present
+    # (the @JsonCreator ctor only covers the six value fields)
+    o = parse_order('{"action":2,"oid":1,"aid":1,"sid":0,"price":5,"size":5,'
+                    '"next":9,"prev":8}')
+    assert o.next == 9 and o.prev == 8
+    o2 = parse_order('{"action":2,"next":null,"prev":null}')
+    assert o2.next is None and o2.prev is None
+
+
+def test_parse_negative_values():
+    o = parse_order('{"action":101,"aid":3,"size":-5000,"sid":-2}')
+    assert o.size == -5000 and o.sid == -2
+
+
+def test_parse_rejects_non_integer():
+    with pytest.raises(ValueError):
+        parse_order('{"action":"BUY"}')
+
+
+def test_roundtrip_is_canonical():
+    raw = '{"size":10,"price":50,"action":2,"oid":1,"aid":2,"sid":3}'
+    assert dumps_order(parse_order(raw)) == (
+        '{"action":2,"oid":1,"aid":2,"sid":3,"price":50,"size":10,'
+        '"next":null,"prev":null}')
+
+
+def test_out_record_wire_line():
+    rec = OutRecord("OUT", OrderMsg(action=7))
+    assert rec.wire() == ('OUT {"action":7,"oid":0,"aid":0,"sid":0,"price":0,'
+                          '"size":0,"next":null,"prev":null}')

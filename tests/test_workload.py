@@ -1,0 +1,81 @@
+"""Workload generator: determinism, preamble shape, distribution sanity,
+and an oracle smoke-run over the harness distribution (the reference's own
+"test" is exactly this: fire random events, assert no crash —
+exchange_test.js:33-36, SURVEY.md §4)."""
+
+import collections
+
+from kme_tpu import opcodes as op
+from kme_tpu.oracle import OracleEngine
+from kme_tpu.workload import WorkloadGen, cancel_heavy_stream, harness_stream, \
+    zipf_symbol_stream
+
+
+def test_deterministic_under_seed():
+    a = harness_stream(500, seed=7)
+    b = harness_stream(500, seed=7)
+    assert a == b
+    c = harness_stream(500, seed=8)
+    assert a != c
+
+
+def test_preamble_shape_matches_reference():
+    # exchange_test.js:23-32 with defaults: 10 accounts (create+transfer
+    # pairs), then the float loop bound `i < 3/2+1` -> 3 symbols
+    pre = WorkloadGen().preamble()
+    assert len(pre) == 23
+    assert [m.action for m in pre[:4]] == [100, 101, 100, 101]
+    assert [m.sid for m in pre[20:]] == [0, 1, 2]
+    # numSymbols=4 also creates only symbols 0..2 (the reference quirk)
+    pre4 = WorkloadGen(num_symbols=4).preamble()
+    assert [m.sid for m in pre4 if m.action == op.ADD_SYMBOL] == [0, 1, 2]
+
+
+def test_event_mix_roughly_matches_per_mille():
+    gen = WorkloadGen(seed=3)
+    counts = collections.Counter(gen.gen_event().action for _ in range(50_000))
+    assert 0.30 < counts[op.BUY] / 50_000 < 0.37
+    assert 0.30 < counts[op.SELL] / 50_000 < 0.37
+    # cancels include the opcode-bugged payouts (both action=4)
+    assert 0.30 < counts[op.CANCEL] / 50_000 < 0.37
+    assert counts[op.PAYOUT] == 0  # Q5: payout opcode bug
+
+
+def test_payout_opcode_fix_flag():
+    gen = WorkloadGen(seed=3, payout_opcode_bug=False)
+    actions = [gen.gen_event().action for _ in range(50_000)]
+    assert op.PAYOUT in actions
+
+
+def test_validate_mode_bounds_domain():
+    for m in harness_stream(5_000, seed=1, validate=True):
+        if m.action in (op.BUY, op.SELL):
+            assert 0 <= m.price <= 125 and m.size >= 1
+
+
+def test_oracle_survives_harness_distribution_java():
+    e = OracleEngine("java")
+    n = 0
+    for m in harness_stream(5_000, seed=11):
+        recs = e.process(m)
+        assert recs[0].key == "IN" and recs[-1].key == "OUT"
+        n += len(recs)
+    assert n >= 10_000
+
+
+def test_oracle_survives_harness_distribution_fixed():
+    e = OracleEngine("fixed")
+    for m in harness_stream(5_000, seed=11, payout_opcode_bug=False,
+                            validate=True):
+        e.process(m)
+    # fixed-mode solvency: no balance ever ends negative
+    assert all(b >= 0 for b in e.balances.values())
+
+
+def test_scale_streams_shape():
+    z = zipf_symbol_stream(2_000, num_symbols=64, num_accounts=128, seed=5)
+    assert sum(1 for m in z if m.action == op.ADD_SYMBOL) == 64
+    ch = cancel_heavy_stream(2_000, num_symbols=8, num_accounts=32, seed=5)
+    cancels = sum(1 for m in ch if m.action == op.CANCEL)
+    # every cancel consumes one prior submit: steady state caps near 50%
+    assert cancels > 0.45 * 2_000
