@@ -1,0 +1,3 @@
+"""Device-side primitive ops: java-exact int64 bit twiddling and dense
+associative tables. Everything here is jit-/vmap-safe (static shapes, no
+data-dependent Python control flow)."""

@@ -1,0 +1,180 @@
+"""Java-exact 64-bit bit ops and price-bitmap scans, on device.
+
+The reference packs each book side's 126 price levels into two Java longs
+(UUID msb/lsb, split at price 63 — KProcessor.java:391-394) and finds the
+best price with double-precision log10 math (KProcessor.java:371-377,
+quirk Q7). The oracle (kme_tpu/oracle/javalong.py) reproduces the float
+formulas verbatim; here the same *semantics* are reproduced with exact
+integer ops, which is both faster on TPU (no float64 emulation) and safe
+against libm differences between XLA and the JVM:
+
+- For the min-scan the float formula is exact on every reachable input
+  (single-set-bit values; proven by tests/test_javalong.py), so an integer
+  lowest-set-bit is identical.
+- For the max-scan the float formula *overshoots by one* on dense values
+  near the top of a 2^t..2^(t+1) range (the reference then NPEs on the
+  missing bucket — oracle's ReferenceCrash). The exact overshoot frontier
+  is precomputed per top-bit position with the host's math.log10 (the same
+  IEEE-754 doubles the oracle uses), so the device returns bit-identical
+  scan results including the overshot ones.
+- Negative/zero inputs follow Java's (int) casts of NaN/-Infinity
+  (0 / Integer.MIN_VALUE), as in javalong._java_int_of_log_ratio.
+
+All shifts mask the count to 6 bits like Java long shifts.
+"""
+
+from __future__ import annotations
+
+import kme_tpu._jaxsetup  # noqa: F401  (jax_enable_x64)
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kme_tpu.oracle.javalong import _java_int_of_log_ratio as _host_int_log_ratio
+
+INT32_MIN = -(1 << 31)
+_I64 = jnp.int64
+
+
+def _compute_overshoot_thresholds() -> np.ndarray:
+    """For each top-bit t in 0..62, the smallest v in [2^t, 2^(t+1)) whose
+    float last-set-bit scan returns t+1 instead of t; -1 if none.
+
+    int(log10(v)/log10(2)) is monotone non-decreasing in v (log10 is
+    monotone and IEEE double log10 preserves that), so binary search finds
+    the exact frontier; the boundary is verified exhaustively ±64 around
+    the found threshold.
+    """
+    thresholds = np.full(63, -1, dtype=np.int64)
+    for t in range(63):
+        lo, hi = 1 << t, (1 << (t + 1)) - 1
+        if _host_int_log_ratio(hi) <= t:
+            continue  # never overshoots in this range
+        # first v with ratio >= t+1
+        a, b = lo, hi
+        while a < b:
+            mid = (a + b) // 2
+            if _host_int_log_ratio(mid) > t:
+                b = mid
+            else:
+                a = mid + 1
+        thr = a
+        for v in range(max(lo, thr - 64), min(hi, thr + 64) + 1):
+            expect = t + 1 if v >= thr else t
+            assert _host_int_log_ratio(v) == expect, (t, v)
+        thresholds[t] = thr
+    return thresholds
+
+
+# 63-entry table, computed once at import (63 binary searches, ~µs each).
+# Kept as numpy: jnp indexing constant-folds it under jit, and importing
+# this module stays free of JAX backend initialization.
+_OVERSHOOT = _compute_overshoot_thresholds()
+
+
+def jshl1(k):
+    """Java `1L << k`: count masked to 6 bits; 1<<63 wraps negative."""
+    return jnp.left_shift(jnp.asarray(1, _I64), jnp.bitwise_and(k, 63).astype(_I64))
+
+
+def jget_bit(n, k):
+    """KProcessor.java:406-408 — `1L == ((n >> k) & 1L)`, arithmetic shift."""
+    shifted = jnp.right_shift(n.astype(_I64), jnp.bitwise_and(k, 63).astype(_I64))
+    return jnp.bitwise_and(shifted, 1) == 1
+
+
+def jset_bit(n, k):
+    """KProcessor.java:410-412 — `n | (1L << k)`."""
+    return jnp.bitwise_or(n.astype(_I64), jshl1(k))
+
+
+def junset_bit(n, k):
+    """KProcessor.java:414-416 — `n & ~(1L << k)`."""
+    return jnp.bitwise_and(n.astype(_I64), jnp.bitwise_not(jshl1(k)))
+
+
+def top_bit(v):
+    """floor(log2(v)) for v > 0 (int64), via smear + popcount."""
+    v = v.astype(_I64)
+    for s in (1, 2, 4, 8, 16, 32):
+        v = jnp.bitwise_or(v, jnp.right_shift(v, s))
+    return (jax.lax.population_count(v) - 1).astype(jnp.int32)
+
+
+def first_set_bit_pos(n):
+    """javalong.first_set_bit_pos_float, exactly (KProcessor.java:371-373).
+
+    v = n & -n is a single set bit; the float formula is exact there
+    (test_javalong), so the answer is popcount(v-1). Java cast quirks:
+    v < 0 (bit 63) -> 0, n == 0 -> Integer.MIN_VALUE.
+    """
+    n = n.astype(_I64)
+    v = jnp.bitwise_and(n, -n)  # int64 two's-complement wrap == jand(n, jneg(n))
+    pos = jax.lax.population_count(v - 1).astype(jnp.int32)
+    out = jnp.where(v < 0, jnp.int32(0), pos)
+    return jnp.where(n == 0, jnp.int32(INT32_MIN), out)
+
+
+def last_set_bit_pos(n):
+    """javalong.last_set_bit_pos_float, exactly (KProcessor.java:375-377),
+    including the Q7 overshoot (returns top+1 past the per-top-bit float
+    frontier — the caller's bucket lookup then misses, as on the JVM)."""
+    n = n.astype(_I64)
+    t = top_bit(jnp.where(n > 0, n, jnp.asarray(1, _I64)))
+    thr = jnp.asarray(_OVERSHOOT, _I64)[jnp.clip(t, 0, 62)]
+    over = jnp.logical_and(thr >= 0, n >= thr)
+    pos = t + over.astype(jnp.int32)
+    out = jnp.where(n < 0, jnp.int32(0), pos)
+    return jnp.where(n == 0, jnp.int32(INT32_MIN), out)
+
+
+# ---------------------------------------------------------------------------
+# Book bitmap helpers (msb carries prices 63..125 at offset price-63,
+# lsb carries 0..62; bit 63 of lsb unused in the valid domain — Q8 — but
+# reachable via negative prices, which the shift masking handles like Java).
+
+def book_min_price(msb, lsb):
+    """getMinPriceBucketPointer (KProcessor.java:359-363)."""
+    empty = jnp.logical_and(lsb == 0, msb == 0)
+    from_msb = first_set_bit_pos(msb) + 63
+    from_lsb = first_set_bit_pos(lsb)
+    return jnp.where(empty, jnp.int32(-1),
+                     jnp.where(lsb == 0, from_msb, from_lsb))
+
+
+def book_max_price(msb, lsb):
+    """getMaxPriceBucketPointer (KProcessor.java:365-369)."""
+    empty = jnp.logical_and(lsb == 0, msb == 0)
+    from_lsb = last_set_bit_pos(lsb)
+    from_msb = last_set_bit_pos(msb) + 63
+    return jnp.where(empty, jnp.int32(-1),
+                     jnp.where(msb == 0, from_lsb, from_msb))
+
+
+def book_check_bit(msb, lsb, price):
+    """checkBit (KProcessor.java:391-394): split at price < 63."""
+    return jnp.where(price < 63, jget_bit(lsb, price), jget_bit(msb, price - 63))
+
+
+def book_with_bit_set(msb, lsb, price):
+    """getWithBitSet (KProcessor.java:396-399) -> (msb, lsb)."""
+    lo = price < 63
+    new_lsb = jnp.where(lo, jset_bit(lsb, price), lsb)
+    new_msb = jnp.where(lo, msb, jset_bit(msb, price - 63))
+    return new_msb, new_lsb
+
+
+def book_with_bit_unset(msb, lsb, price):
+    """getWithBitUnset (KProcessor.java:401-404) -> (msb, lsb)."""
+    lo = price < 63
+    new_lsb = jnp.where(lo, junset_bit(lsb, price), lsb)
+    new_msb = jnp.where(lo, msb, junset_bit(msb, price - 63))
+    return new_msb, new_lsb
+
+
+def bucket_key(book_key, price):
+    """getBucketPointer (KProcessor.java:379-381): (key << 8) | (long)price
+    with Java wrap; a negative price sign-extends and floods the high bits,
+    exactly as on the JVM."""
+    shifted = jnp.left_shift(book_key.astype(_I64), 8)
+    return jnp.bitwise_or(shifted, price.astype(_I64))

@@ -1,0 +1,65 @@
+"""Dense associative tables — the device equivalent of the reference's
+RocksDB KeyValueStores (KProcessor.java:30-49).
+
+The reference's five stores are hash maps behind JNI; on TPU the same
+get/put/delete contract is a masked vector compare over a fixed-capacity
+slot array: lookup is one `==` broadcast + argmax (VPU-friendly, O(1)
+depth), insert picks the first free slot, delete clears the used bit.
+Fixed capacity is the one semantic difference — overflow is reported via
+a sticky flag the host checks per batch (SURVEY.md §7 H2: overflow policy
+is explicit, not silent).
+
+Keys are int64 (single or pair — the reference's UUID keys are two longs).
+Slot 0 is a real slot; "not found" is the separate `found` boolean, so
+callers must gate every gather/scatter on it.
+"""
+
+from __future__ import annotations
+
+import kme_tpu._jaxsetup  # noqa: F401
+import jax.numpy as jnp
+
+
+def find(keys, used, k):
+    """Index of the used slot holding key `k` -> (idx:int32, found:bool).
+
+    Keys are unique among used slots (put_idx never duplicates), so argmax
+    over the hit mask is THE slot.
+    """
+    hit = jnp.logical_and(used, keys == k)
+    return jnp.argmax(hit).astype(jnp.int32), jnp.any(hit)
+
+
+def find2(keys_a, keys_b, used, ka, kb):
+    """Pair-key lookup (UUID-keyed stores: positions (aid,sid),
+    KProcessor.java:418-425)."""
+    hit = jnp.logical_and(used, jnp.logical_and(keys_a == ka, keys_b == kb))
+    return jnp.argmax(hit).astype(jnp.int32), jnp.any(hit)
+
+
+def alloc(used):
+    """First free slot -> (idx:int32, ok:bool). ok=False means the table
+    is full (capacity overflow — host-visible error)."""
+    free = jnp.logical_not(used)
+    return jnp.argmax(free).astype(jnp.int32), jnp.any(free)
+
+
+def put_idx(keys, used, k):
+    """Slot to write key `k` into: the existing slot if present, else a
+    fresh one -> (idx:int32, ok:bool). Mirrors map.put upsert semantics."""
+    idx, found = find(keys, used, k)
+    fresh, ok = alloc(used)
+    return jnp.where(found, idx, fresh), jnp.logical_or(found, ok)
+
+
+def put2_idx(keys_a, keys_b, used, ka, kb):
+    """Pair-key upsert slot -> (idx:int32, ok:bool)."""
+    idx, found = find2(keys_a, keys_b, used, ka, kb)
+    fresh, ok = alloc(used)
+    return jnp.where(found, idx, fresh), jnp.logical_or(found, ok)
+
+
+def delete_at(used, idx, present):
+    """Clear slot `idx` when `present`; no-op otherwise. The slot's other
+    columns may be left stale — `used` alone defines liveness."""
+    return used.at[idx].set(jnp.logical_and(used[idx], jnp.logical_not(present)))

@@ -1,0 +1,149 @@
+"""Device bit ops vs the oracle's java-exact host implementations.
+
+The device layer claims bit-identical semantics with javalong's float
+scans (including the Q7 overshoot) and the book/bucket codecs; these
+tests drive both over adversarial int64 inputs.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kme_tpu.oracle import javalong as jl
+from kme_tpu.ops import bits
+
+
+def _rand64(rng, n):
+    vals = []
+    for _ in range(n):
+        kind = rng.randrange(4)
+        if kind == 0:
+            vals.append(rng.getrandbits(64))
+        elif kind == 1:  # sparse
+            v = 0
+            for _ in range(rng.randrange(1, 4)):
+                v |= 1 << rng.randrange(64)
+            vals.append(v)
+        elif kind == 2:  # dense top region (Q7 frontier)
+            t = rng.randrange(40, 63)
+            vals.append(((1 << (t + 1)) - 1) - rng.randrange(1 << 8))
+        else:
+            vals.append(rng.getrandbits(rng.randrange(1, 64)))
+    return [jl.jlong(v) for v in vals]
+
+
+@pytest.fixture(scope="module")
+def samples():
+    rng = random.Random(7)
+    vals = _rand64(rng, 4000)
+    vals += [0, 1, -1, jl.jlong(1 << 63), (1 << 62), (1 << 63) - 1]
+    # exact overshoot frontiers
+    for t, thr in enumerate(int(x) for x in bits._OVERSHOOT):
+        if thr > 0:
+            vals += [thr - 1, thr, jl.jlong(thr + 1)]
+    return vals
+
+
+def test_first_set_bit_matches_oracle(samples):
+    got = np.asarray(jax.jit(bits.first_set_bit_pos)(jnp.asarray(samples, jnp.int64)))
+    want = [jl.first_set_bit_pos_float(v) for v in samples]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_last_set_bit_matches_oracle(samples):
+    got = np.asarray(jax.jit(bits.last_set_bit_pos)(jnp.asarray(samples, jnp.int64)))
+    want = [jl.last_set_bit_pos_float(v) for v in samples]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bit_ops_match_java_semantics():
+    rng = random.Random(11)
+    ns = jnp.asarray(_rand64(rng, 512), jnp.int64)
+    # prices incl. negatives and >125 (java shift masking paths)
+    ks = jnp.asarray([rng.randrange(-130, 260) for _ in range(512)], jnp.int32)
+    get, st, un = (np.asarray(x) for x in jax.jit(
+        lambda n, k: (bits.jget_bit(n, k), bits.jset_bit(n, k),
+                      bits.junset_bit(n, k)))(ns, ks))
+    ns_h, ks_h = np.asarray(ns), np.asarray(ks)
+    for i in range(512):
+        n, k = int(ns_h[i]), int(ks_h[i])
+        assert bool(get[i]) == jl.get_bit(n, k)
+        assert int(st[i]) == jl.set_bit(n, k)
+        assert int(un[i]) == jl.unset_bit(n, k)
+
+
+def test_book_scan_and_bitmask_roundtrip():
+    """Drive the book codec through the oracle's helpers on random
+    (msb, lsb) pairs and price operations (vectorized: one device call
+    per op, host loop only for the oracle side)."""
+    from kme_tpu.oracle import engine as oe
+
+    rng = random.Random(3)
+    n = 300
+    msbs = [jl.jlong(rng.getrandbits(rng.randrange(0, 63))) for _ in range(n)]
+    lsbs = [jl.jlong(rng.getrandbits(rng.randrange(0, 63))) for _ in range(n)]
+    prices = [rng.randrange(-5, 130) for _ in range(n)]
+    m = jnp.asarray(msbs, jnp.int64)
+    l = jnp.asarray(lsbs, jnp.int64)
+    p = jnp.asarray(prices, jnp.int32)
+    mn, mx, cb, (sm, sl), (um, ul) = jax.tree.map(np.asarray, jax.jit(
+        lambda m, l, p: (bits.book_min_price(m, l), bits.book_max_price(m, l),
+                         bits.book_check_bit(m, l, p),
+                         bits.book_with_bit_set(m, l, p),
+                         bits.book_with_bit_unset(m, l, p)))(m, l, p))
+    for i in range(n):
+        book = (msbs[i], lsbs[i])
+        price = prices[i]
+        assert int(mn[i]) == oe._book_min_price(book)
+        assert int(mx[i]) == oe._book_max_price(book)
+        assert bool(cb[i]) == oe._check_bit(book, price)
+        assert (int(sm[i]), int(sl[i])) == oe._with_bit_set(book, price)
+        assert (int(um[i]), int(ul[i])) == oe._with_bit_unset(book, price)
+
+
+def test_bucket_key_matches_java_promotion():
+    from kme_tpu.oracle.engine import OracleEngine
+
+    eng = OracleEngine("java")
+    rng = random.Random(5)
+    n = 200
+    bkeys = [jl.jlong(rng.getrandbits(64)) for _ in range(n)]
+    prices = [rng.randrange(-300, 300) for _ in range(n)]
+    got = np.asarray(bits.bucket_key(jnp.asarray(bkeys, jnp.int64),
+                                     jnp.asarray(prices, jnp.int32)))
+    for i in range(n):
+        assert int(got[i]) == eng._bucket_key(bkeys[i], prices[i])
+
+
+def test_tables_find_put_delete():
+    from kme_tpu.ops import tables
+
+    @jax.jit
+    def drive(keys, used, full):
+        idx9, found9 = tables.find(keys, used, jnp.asarray(9, jnp.int64))
+        return ((idx9, found9),
+                tables.find(keys, used, jnp.asarray(0, jnp.int64)),
+                tables.alloc(used),
+                tables.put_idx(keys, used, jnp.asarray(7, jnp.int64)),
+                tables.alloc(full),
+                tables.delete_at(used, idx9, found9))
+
+    keys = jnp.asarray([5, 9, 0, 7], jnp.int64)
+    used = jnp.asarray([True, True, False, True])
+    (f9, f0, al, up, alf, deleted) = drive(keys, used, jnp.ones(4, bool))
+    assert bool(f9[1]) and int(f9[0]) == 1
+    assert not bool(f0[1])  # slot 2 holds key 0 but is unused
+    assert bool(al[1]) and int(al[0]) == 2
+    assert bool(up[1]) and int(up[0]) == 3  # upsert hits existing slot
+    assert not bool(alf[1])  # full table reports overflow
+    assert list(np.asarray(deleted)) == [True, False, False, True]
+
+
+def test_ops_are_jittable_and_vmappable():
+    f = jax.jit(jax.vmap(bits.last_set_bit_pos))
+    out = f(jnp.asarray([0, 1, 6, -2], jnp.int64))
+    assert out.shape == (4,)
+    assert int(out[2]) == 2
