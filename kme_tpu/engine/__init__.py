@@ -1,0 +1,9 @@
+"""Device engines.
+
+- `parity`: the serial-in-time device replica of the reference engine —
+  one message at a time under `lax.scan`, dense associative stores,
+  byte-exact vs the scalar oracle in both compat modes. The parity judge
+  for everything faster.
+- `lanes` (throughput engine): vmapped per-symbol order books, fixed-mode
+  semantics, sharded over the symbol mesh axis.
+"""

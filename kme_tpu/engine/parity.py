@@ -1,0 +1,1091 @@
+"""Serial device replica of the reference matching engine.
+
+This is build-plan step 2 (SURVEY.md §7): the whole of
+`KProcessor.MatchingEngine` (/root/reference/src/main/java/KProcessor.java:63-445)
+as ONE jitted `lax.scan` over a micro-batch of wire messages, processing
+strictly in arrival order (the reference's single-StreamThread semantics,
+SURVEY.md §2.3) with every store replaced by a dense associative table on
+device (ops/tables.py) and every bitmap/bucket codec replaced by the
+java-exact integer ops (ops/bits.py).
+
+Semantics contract: for any message stream whose price/size fields fit in
+int32 and ids in int64 (the Jackson-parseable envelope — out-of-range
+values kill the reference's deserializer), the output stream equals
+`kme_tpu.oracle.OracleEngine` byte for byte, in both compat modes,
+including the quirk ledger Q1..Q11 (SURVEY.md §2.5). Paths where the
+reference *dies* (NPE crashes, the Q4 infinite loop) surface as a sticky
+per-batch error code at the offending message index instead of an
+exception; the host wrapper truncates there and raises, mirroring the
+oracle's ReferenceCrash/ReferenceHang.
+
+Capacity is the one new degree of freedom (H2/H3): tables are fixed-size
+and each message can emit at most `max_events` fills; exhaustion raises a
+distinct error code (the reference's stores/lists are unbounded).
+
+Design notes (TPU-first):
+- No data-dependent Python control flow: dispatch is `lax.switch` over
+  dense op codes, the match loop is `lax.while_loop` bounded by the fill
+  buffer, stores are O(1)-depth masked vector compares (VPU work).
+- The scan carries the full store pytree; buffers are donated by the
+  host wrapper so state stays device-resident across batches.
+- All arithmetic is int32/int64 with Java wrap semantics (hardware
+  two's-complement — no float in the engine path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import kme_tpu._jaxsetup  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kme_tpu import opcodes as op
+from kme_tpu.ops import bits, tables
+from kme_tpu.wire import OrderMsg, OutRecord
+
+_I64 = jnp.int64
+_I32 = jnp.int32
+
+# Padding marker for partial batches (explicit `pad` lane flag, so every
+# int32 wire action stays representable): no state change, no output.
+NOP_PAD = -(1 << 31)  # conventional action value for pad lanes (flag rules)
+
+# Error codes (sticky per batch; 0 = ok)
+ERR_OK = 0
+ERR_HANG = 1          # Q4 removeAllOrders infinite loop (ReferenceHang)
+ERR_CRASH = 2         # reference NPE / death (ReferenceCrash / KeyError)
+ERR_TABLE_FULL = 3    # store capacity exhausted (device-only envelope)
+ERR_EVENTS_FULL = 4   # fill buffer exhausted (device-only envelope)
+
+_ERR_NAMES = {
+    ERR_HANG: "reference-hang (Q4 removeAllOrders loop)",
+    ERR_CRASH: "reference-crash (NPE path)",
+    ERR_TABLE_FULL: "device store capacity exhausted",
+    ERR_EVENTS_FULL: "device fill-event buffer exhausted",
+}
+
+
+class DeviceParityError(RuntimeError):
+    """Raised by the host wrapper when the device engine flags an error.
+
+    `index` is the position (within the process_batch call) of the message
+    on which the reference would have died or the device ran out of
+    capacity; records for earlier messages are still valid and were
+    emitted."""
+
+    def __init__(self, code: int, index: int,
+                 records: Optional[List[List["OutRecord"]]] = None) -> None:
+        self.code = int(code)
+        self.index = int(index)
+        self.records = records or []  # per-message records before death
+        super().__init__(
+            f"device engine error at message {index}: "
+            f"{_ERR_NAMES.get(self.code, self.code)}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ParityCaps:
+    """Static table capacities (one XLA program per distinct value)."""
+
+    balances: int = 64        # AB — accounts
+    positions: int = 4096     # PB — (aid,sid) pairs incl. Q11 garbage keys
+    books: int = 64           # BB — 2 per symbol
+    buckets: int = 1024       # KB — occupied price levels
+    orders: int = 8192        # OB — resting orders
+    max_events: int = 64      # E — fill events per message (2/trade)
+    batch: int = 256          # T — scan steps per device dispatch
+
+
+def make_state(caps: ParityCaps) -> Dict[str, jax.Array]:
+    """Fresh empty store pytree (the reference's five empty stores)."""
+    def z(n, dt):
+        return jnp.zeros((n,), dt)
+
+    return {
+        "bal_key": z(caps.balances, _I64),
+        "bal_val": z(caps.balances, _I64),
+        "bal_used": z(caps.balances, bool),
+        # positions: key UUID(aid, sid) -> value UUID(amount, available)
+        # (KProcessor.java:418-444); Q11 garbage keys live here too.
+        "pos_ka": z(caps.positions, _I64),
+        "pos_ks": z(caps.positions, _I64),
+        "pos_amt": z(caps.positions, _I64),
+        "pos_avail": z(caps.positions, _I64),
+        "pos_used": z(caps.positions, bool),
+        # books: signed-sid key -> 126-bit bitmap in (msb, lsb)
+        "book_key": z(caps.books, _I64),
+        "book_msb": z(caps.books, _I64),
+        "book_lsb": z(caps.books, _I64),
+        "book_used": z(caps.books, bool),
+        # buckets: (book_key<<8)|price -> (first oid, last oid)
+        "bkt_key": z(caps.buckets, _I64),
+        "bkt_first": z(caps.buckets, _I64),
+        "bkt_last": z(caps.buckets, _I64),
+        "bkt_used": z(caps.buckets, bool),
+        # orders: oid -> Order record (intrusive doubly-linked list via
+        # next/prev + nullability flags, KProcessor.java:448-475)
+        "ord_oid": z(caps.orders, _I64),
+        "ord_action": z(caps.orders, _I32),
+        "ord_aid": z(caps.orders, _I64),
+        "ord_sid": z(caps.orders, _I64),
+        "ord_price": z(caps.orders, _I32),
+        "ord_size": z(caps.orders, _I32),
+        "ord_next": z(caps.orders, _I64),
+        "ord_next_has": z(caps.orders, bool),
+        "ord_prev": z(caps.orders, _I64),
+        "ord_prev_has": z(caps.orders, bool),
+        "ord_used": z(caps.orders, bool),
+    }
+
+
+# ---------------------------------------------------------------------------
+# small store helpers. Every mutator threads (state, err); err is sticky
+# and mutators become no-ops once err != 0 (the oracle raises immediately;
+# keeping later writes out preserves "state at death" comparability).
+
+def _guard(err, new_err_cond, code):
+    return jnp.where((err == ERR_OK) & new_err_cond, jnp.int32(code), err)
+
+
+def _sel(pred, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _bal_get(st, aid):
+    idx, found = tables.find(st["bal_key"], st["bal_used"], aid)
+    return st["bal_val"][idx], found
+
+
+def _bal_put(st, err, aid, val):
+    idx, ok = tables.put_idx(st["bal_key"], st["bal_used"], aid)
+    err = _guard(err, ~ok, ERR_TABLE_FULL)
+    do = err == ERR_OK
+    st = dict(st)
+    st["bal_key"] = jnp.where(do, st["bal_key"].at[idx].set(aid), st["bal_key"])
+    st["bal_val"] = jnp.where(do, st["bal_val"].at[idx].set(val), st["bal_val"])
+    st["bal_used"] = jnp.where(do, st["bal_used"].at[idx].set(True), st["bal_used"])
+    return st, err
+
+
+def _pos_get(st, ka, ks):
+    idx, found = tables.find2(st["pos_ka"], st["pos_ks"], st["pos_used"], ka, ks)
+    return st["pos_amt"][idx], st["pos_avail"][idx], found
+
+
+def _pos_put(st, err, ka, ks, amt, avail):
+    idx, ok = tables.put2_idx(st["pos_ka"], st["pos_ks"], st["pos_used"], ka, ks)
+    err = _guard(err, ~ok, ERR_TABLE_FULL)
+    do = err == ERR_OK
+    st = dict(st)
+    for name, v in (("pos_ka", ka), ("pos_ks", ks), ("pos_amt", amt),
+                    ("pos_avail", avail)):
+        st[name] = jnp.where(do, st[name].at[idx].set(v), st[name])
+    st["pos_used"] = jnp.where(do, st["pos_used"].at[idx].set(True), st["pos_used"])
+    return st, err
+
+
+def _pos_del(st, err, ka, ks):
+    """positions.delete(key): no-op when absent (RocksDB delete semantics,
+    KProcessor.java:283 — the oracle's dict .pop(key, None))."""
+    idx, found = tables.find2(st["pos_ka"], st["pos_ks"], st["pos_used"], ka, ks)
+    st = dict(st)
+    st["pos_used"] = jnp.where(
+        err == ERR_OK, tables.delete_at(st["pos_used"], idx, found), st["pos_used"])
+    return st
+
+
+def _book_get(st, key):
+    idx, found = tables.find(st["book_key"], st["book_used"], key)
+    return st["book_msb"][idx], st["book_lsb"][idx], found
+
+
+def _book_put(st, err, key, msb, lsb):
+    idx, ok = tables.put_idx(st["book_key"], st["book_used"], key)
+    err = _guard(err, ~ok, ERR_TABLE_FULL)
+    do = err == ERR_OK
+    st = dict(st)
+    for name, v in (("book_key", key), ("book_msb", msb), ("book_lsb", lsb)):
+        st[name] = jnp.where(do, st[name].at[idx].set(v), st[name])
+    st["book_used"] = jnp.where(do, st["book_used"].at[idx].set(True), st["book_used"])
+    return st, err
+
+
+def _book_del(st, err, key):
+    idx, found = tables.find(st["book_key"], st["book_used"], key)
+    st = dict(st)
+    st["book_used"] = jnp.where(
+        err == ERR_OK, tables.delete_at(st["book_used"], idx, found), st["book_used"])
+    return st
+
+
+def _bkt_get(st, key):
+    idx, found = tables.find(st["bkt_key"], st["bkt_used"], key)
+    return st["bkt_first"][idx], st["bkt_last"][idx], found
+
+
+def _bkt_put(st, err, key, first, last):
+    idx, ok = tables.put_idx(st["bkt_key"], st["bkt_used"], key)
+    err = _guard(err, ~ok, ERR_TABLE_FULL)
+    do = err == ERR_OK
+    st = dict(st)
+    for name, v in (("bkt_key", key), ("bkt_first", first), ("bkt_last", last)):
+        st[name] = jnp.where(do, st[name].at[idx].set(v), st[name])
+    st["bkt_used"] = jnp.where(do, st["bkt_used"].at[idx].set(True), st["bkt_used"])
+    return st, err
+
+
+def _bkt_del(st, err, key):
+    idx, found = tables.find(st["bkt_key"], st["bkt_used"], key)
+    st = dict(st)
+    st["bkt_used"] = jnp.where(
+        err == ERR_OK, tables.delete_at(st["bkt_used"], idx, found), st["bkt_used"])
+    return st
+
+
+_ORD_FIELDS = ("ord_oid", "ord_action", "ord_aid", "ord_sid", "ord_price",
+               "ord_size", "ord_next", "ord_next_has", "ord_prev",
+               "ord_prev_has")
+
+
+def _ord_get(st, oid):
+    """-> (record dict, found). Record values are gathered at the hit slot
+    (slot 0 garbage when not found — callers gate on `found`)."""
+    idx, found = tables.find(st["ord_oid"], st["ord_used"], oid)
+    rec = {f: st[f][idx] for f in _ORD_FIELDS}
+    return rec, found
+
+
+def _ord_put(st, err, rec):
+    idx, ok = tables.put_idx(st["ord_oid"], st["ord_used"], rec["ord_oid"])
+    err = _guard(err, ~ok, ERR_TABLE_FULL)
+    do = err == ERR_OK
+    st = dict(st)
+    for f in _ORD_FIELDS:
+        st[f] = jnp.where(do, st[f].at[idx].set(rec[f]), st[f])
+    st["ord_used"] = jnp.where(do, st["ord_used"].at[idx].set(True), st["ord_used"])
+    return st, err
+
+
+def _ord_del(st, err, oid):
+    idx, found = tables.find(st["ord_oid"], st["ord_used"], oid)
+    st = dict(st)
+    st["ord_used"] = jnp.where(
+        err == ERR_OK, tables.delete_at(st["ord_used"], idx, found), st["ord_used"])
+    return st
+
+
+def _order_rec(action, oid, aid, sid, price, size, nxt, nxt_has, prv, prv_has):
+    return {
+        "ord_oid": oid.astype(_I64), "ord_action": action.astype(_I32),
+        "ord_aid": aid.astype(_I64), "ord_sid": sid.astype(_I64),
+        "ord_price": price.astype(_I32), "ord_size": size.astype(_I32),
+        "ord_next": nxt.astype(_I64), "ord_next_has": nxt_has,
+        "ord_prev": prv.astype(_I64), "ord_prev_has": prv_has,
+    }
+
+
+# ---------------------------------------------------------------------------
+# key codecs (oracle._order_book_key / _bucket_key)
+
+def _order_book_key(sid, is_buy, java: bool):
+    """Java: sid * (+1|-1) with long wrap (Q1: -0 == 0 merges sid=0's
+    sides, KProcessor.java:201/227/292). Fixed: 2*sid + side."""
+    sid = sid.astype(_I64)
+    if java:
+        return jnp.where(is_buy, sid, -sid)
+    return 2 * sid + jnp.where(is_buy, 0, 1).astype(_I64)
+
+
+# ---------------------------------------------------------------------------
+# handlers — each: (st, err, msg, outbuf) -> (st, err, result, echo, outbuf)
+# msg is a dict of scalars; echo is (size, prev, prev_has) mutations.
+# outbuf is (events (E,6) i64, n i32).
+
+def _echo_of(msg):
+    return {"size": msg["size"], "prev": msg["prev"], "prev_has": msg["prev_has"]}
+
+
+def _h_create_balance(st, err, msg, outbuf, java):
+    """createBalance (KProcessor.java:131-138): idempotent create at 0."""
+    _, found = _bal_get(st, msg["aid"])
+    st2, err2 = _bal_put(st, err, msg["aid"], jnp.asarray(0, _I64))
+    st = _sel(~found, st2, st)
+    err = jnp.where(~found, err2, err)
+    return st, err, ~found, _echo_of(msg), outbuf
+
+
+def _h_transfer(st, err, msg, outbuf, java):
+    """transfer (KProcessor.java:140-146): balance += size, withdrawal
+    guarded by `balance < -size`."""
+    bal, found = _bal_get(st, msg["aid"])
+    size = msg["size"].astype(_I64)
+    ok = found & ~(bal < -size)
+    st2, err2 = _bal_put(st, err, msg["aid"], bal + size)
+    st = _sel(ok, st2, st)
+    err = jnp.where(ok, err2, err)
+    return st, err, ok, _echo_of(msg), outbuf
+
+
+def _h_add_symbol(st, err, msg, outbuf, java):
+    """addSymbol (KProcessor.java:184-191): empty books at ±sid (java) or
+    2*sid+side (fixed; sid < 0 rejected)."""
+    sid = msg["sid"].astype(_I64)
+    zero = jnp.asarray(0, _I64)
+    if java:
+        k1, k2 = sid, -sid
+        _, _, exists = _book_get(st, k1)
+        ok = ~exists
+    else:
+        k1, k2 = 2 * sid, 2 * sid + 1
+        _, _, exists = _book_get(st, k1)
+        ok = (sid >= 0) & ~exists
+    st2, err2 = _book_put(st, err, k1, zero, zero)
+    st2, err2 = _book_put(st2, err2, k2, zero, zero)
+    st = _sel(ok, st2, st)
+    err = jnp.where(ok, err2, err)
+    return st, err, ok, _echo_of(msg), outbuf
+
+
+def _check_balance(st, err, aid, sid, price, is_buy, size_in, java):
+    """checkBalance (KProcessor.java:167-182): margin reserve with netting
+    against the opposite 'available' position -> (st, err, ok)."""
+    bal, found = _bal_get(st, aid)
+    size32 = jnp.where(is_buy, size_in, -size_in).astype(_I32)
+    size = size32.astype(_I64)
+    # `-size` is Java int negation: wraps at int32 before promotion
+    neg_size = (-size32).astype(_I64)
+    amt, avail, pos_found = _pos_get(st, aid.astype(_I64), sid.astype(_I64))
+    avail = jnp.where(pos_found, avail, 0)
+    adj = jnp.where(is_buy,
+                    jnp.maximum(jnp.minimum(avail, 0), neg_size),
+                    jnp.minimum(jnp.maximum(avail, 0), neg_size))
+    unit = jnp.where(is_buy, price, price - 100).astype(_I64)
+    risk = (size + adj) * unit
+    ok = found & ~(bal < risk)
+    st2, err2 = _bal_put(st, err, aid, bal - risk)
+    adj_write = ok & (adj != 0)
+    # adj-write uses the REAL key (3-arg setPosition, KProcessor.java:179)
+    st3, err3 = _pos_put(st2, err2, aid.astype(_I64), sid.astype(_I64),
+                         amt, avail - adj)
+    st2 = _sel(adj_write, st3, st2)
+    err2 = jnp.where(adj_write, err3, err2)
+    st = _sel(ok, st2, st)
+    err = jnp.where(ok, err2, err)
+    return st, err, ok
+
+
+def _post_remove_adjustments(st, err, rec, java):
+    """postRemoveAdjustments (KProcessor.java:325-333): margin release;
+    Q11 in java mode — the adj-write keys by the position VALUE."""
+    is_buy = rec["ord_action"] == op.BUY
+    size32 = jnp.where(is_buy, rec["ord_size"], -rec["ord_size"]).astype(_I32)
+    size = size32.astype(_I64)
+    neg_size = (-size32).astype(_I64)  # Java int negation (wraps at int32)
+    aid, sid = rec["ord_aid"], rec["ord_sid"]
+    amt, avail, pos_found = _pos_get(st, aid, sid)
+    blocked = jnp.where(pos_found, amt - avail, 0)
+    adj = jnp.where(is_buy,
+                    jnp.maximum(jnp.minimum(blocked, 0), neg_size),
+                    jnp.minimum(jnp.maximum(blocked, 0), neg_size))
+    bal, found = _bal_get(st, aid)
+    err = _guard(err, ~found, ERR_CRASH)  # NPE: release with no balance
+    unit = jnp.where(is_buy, rec["ord_price"], rec["ord_price"] - 100).astype(_I64)
+    st, err = _bal_put(st, err, aid, bal + (size + adj) * unit)
+    adj_write = adj != 0  # implies pos_found
+    tka = jnp.where(jnp.asarray(java), amt, aid)    # Q11 target
+    tks = jnp.where(jnp.asarray(java), avail, sid)
+    st2, err2 = _pos_put(st, err, tka, tks, amt, avail + adj)
+    st = _sel(adj_write, st2, st)
+    err = jnp.where(adj_write, err2, err)
+    return st, err
+
+
+def _fill_order(st, err, outbuf, action, oid, aid, sid, price, size, java,
+                max_events):
+    """fillOrder (KProcessor.java:276-287) + the event forward
+    (KProcessor.java:272-273). Q11 in java mode: update/delete of an
+    existing position keys by the VALUE pair."""
+    events, n = outbuf
+    err = _guard(err, n >= max_events, ERR_EVENTS_FULL)
+    row = jnp.stack([action.astype(_I64), oid.astype(_I64), aid.astype(_I64),
+                     sid.astype(_I64), price.astype(_I64), size.astype(_I64)])
+    do = err == ERR_OK
+    events = jnp.where(do, events.at[jnp.clip(n, 0, max_events - 1)].set(row),
+                       events)
+    n = jnp.where(do, n + 1, n)
+
+    signed = jnp.where(action == op.BOUGHT, size, -size).astype(_I32).astype(_I64)
+    ka, ks = aid.astype(_I64), sid.astype(_I64)
+    amt, avail, found = _pos_get(st, ka, ks)
+    # create path
+    st_new, err_new = _pos_put(st, err, ka, ks, signed, signed)
+    # update/delete path (java: garbage target = old value pair)
+    new_amt = amt + signed
+    tka = jnp.where(jnp.asarray(java), amt, ka)
+    tks = jnp.where(jnp.asarray(java), avail, ks)
+    st_del = _pos_del(st, err, tka, tks)
+    st_upd, err_upd = _pos_put(st, err, tka, tks, new_amt, avail + signed)
+    st_old = _sel(new_amt == 0, st_del, st_upd)
+    err_old = jnp.where(new_amt == 0, err, err_upd)
+    st = _sel(found, st_old, st_new)
+    err = jnp.where(found, err_old, err_new)
+
+    bal, bfound = _bal_get(st, aid)
+    err = _guard(err, ~bfound, ERR_CRASH)  # NPE: fill with no balance
+    st, err = _bal_put(st, err, aid, bal + signed * price.astype(_I64))
+    return st, err, (events, n)
+
+
+def _execute_trade(st, err, outbuf, taker, maker, trade_size, taker_is_buy,
+                   java, max_events):
+    """executeTrade (KProcessor.java:265-274): maker fill at price 0 first,
+    taker fill at the price improvement second."""
+    maker_action = jnp.where(taker_is_buy, op.SOLD, op.BOUGHT).astype(_I32)
+    taker_action = jnp.where(taker_is_buy, op.BOUGHT, op.SOLD).astype(_I32)
+    improvement = (taker["price"] - maker["ord_price"]).astype(_I32)
+    st, err, outbuf = _fill_order(
+        st, err, outbuf, maker_action, maker["ord_oid"], maker["ord_aid"],
+        maker["ord_sid"], jnp.asarray(0, _I32), trade_size, java, max_events)
+    st, err, outbuf = _fill_order(
+        st, err, outbuf, taker_action, taker["oid"], taker["aid"],
+        taker["sid"], improvement, trade_size, java, max_events)
+    return st, err, outbuf
+
+
+def _try_match(st, err, msg, outbuf, taker_size, java, max_events):
+    """tryMatch (KProcessor.java:225-263) as a bounded lax.while_loop.
+
+    Returns (st, err, outbuf, matched:bool, taker_size). The Q2 guard
+    precedence is replicated in java mode. Loop is bounded by the fill
+    buffer: each iteration emits 2 events or exits."""
+    taker_is_buy = msg["action"] == op.BUY
+    limit = msg["price"]
+    opp_key = _order_book_key(msg["sid"], ~taker_is_buy, java)
+    msb, lsb, book_found = _book_get(st, opp_key)
+    err = _guard(err, ~book_found, ERR_CRASH)  # NPE: opposite book missing
+
+    price_bit = jnp.where(taker_is_buy, bits.book_min_price(msb, lsb),
+                          bits.book_max_price(msb, lsb))
+    empty = price_bit == -1
+
+    bkey = bits.bucket_key(opp_key, price_bit)
+    bfirst, blast, bfound = _bkt_get(st, bkey)
+    err = _guard(err, ~empty & ~bfound, ERR_CRASH)  # Q7 overshoot NPE
+    maker, mfound = _ord_get(st, bfirst)
+    err = _guard(err, ~empty & bfound & ~mfound, ERR_CRASH)
+
+    def cross_guard(tsize, maker_rec):
+        mp = maker_rec["ord_price"]
+        if java:  # Q2: (size>0 && isBuy) ? (mp <= limit) : (mp >= limit)
+            return jnp.where((tsize > 0) & taker_is_buy, mp <= limit, mp >= limit)
+        return (tsize > 0) & jnp.where(taker_is_buy, mp <= limit, mp >= limit)
+
+    def cond(c):
+        return (c["err"] == ERR_OK) & ~c["done"]
+
+    def body(c):
+        st, err, outbuf = c["st"], c["err"], c["outbuf"]
+        maker = c["maker"]
+        tsize = c["tsize"]
+        guard = cross_guard(tsize, maker)
+
+        # --- trade at maker price (KProcessor.java:238-241)
+        trade_size = jnp.minimum(tsize, maker["ord_size"])
+        maker_sz = (maker["ord_size"] - trade_size).astype(_I32)
+        tsize_new = (tsize - trade_size).astype(_I32)
+        taker_view = {"oid": c["taker_oid"], "aid": c["taker_aid"],
+                      "sid": c["taker_sid"], "price": c["taker_price"]}
+        maker_traded = dict(maker)
+        maker_traded["ord_size"] = maker_sz
+        st_t, err_t, outbuf_t = _execute_trade(
+            st, err, outbuf, taker_view, maker_traded, trade_size,
+            taker_is_buy, java, max_events)
+
+        # exhausted maker? delete and advance (KProcessor.java:242-257)
+        exhausted = maker_sz == 0
+        st_d = _ord_del(st_t, err_t, maker["ord_oid"])
+
+        # advance within bucket or to next price level
+        has_next = maker["ord_next_has"]
+        # next-level path: delete bucket, clear bit, rescan
+        st_nl = _bkt_del(st_d, err_t, c["bkey"])
+        nmsb, nlsb = bits.book_with_bit_unset(c["msb"], c["lsb"],
+                                              maker["ord_price"])
+        st_nl, err_nl = _book_put(st_nl, err_t, opp_key, nmsb, nlsb)
+        nprice = jnp.where(taker_is_buy, bits.book_min_price(nmsb, nlsb),
+                           bits.book_max_price(nmsb, nlsb))
+        book_empty = nprice == -1
+        nbkey = bits.bucket_key(opp_key, nprice)
+        nbfirst, nblast, nbfound = _bkt_get(st_nl, nbkey)
+        err_nl = _guard(err_nl, ~book_empty & ~nbfound, ERR_CRASH)
+
+        # merge: next_ptr/bucket depending on path
+        adv_ptr = jnp.where(has_next, maker["ord_next"], nbfirst)
+        new_bkey = jnp.where(has_next, c["bkey"], nbkey)
+        new_blast = jnp.where(has_next, c["blast"], nblast)
+        new_msb = jnp.where(has_next, c["msb"], nmsb)
+        new_lsb = jnp.where(has_next, c["lsb"], nlsb)
+        st_adv = _sel(has_next, st_d, st_nl)
+        err_adv = jnp.where(has_next, err_t, err_nl)
+
+        nmaker, nmfound = _ord_get(st_adv, adv_ptr)
+        fetch_ok = has_next | ~book_empty
+        err_adv = _guard(err_adv, fetch_ok & ~nmfound, ERR_CRASH)
+
+        # --- compose iteration outcome
+        # 1. guard false -> done, exit with writeback (state untouched)
+        # 2. traded, maker not exhausted -> done, writeback, maker mutated
+        # 3. exhausted, book empty after level clear -> done, NO writeback
+        # 4. advanced -> continue
+        cont = guard & exhausted & fetch_ok
+        done = ~cont
+        no_wb = guard & exhausted & ~has_next & book_empty
+        out = {}
+        out["st"] = _sel(guard, _sel(exhausted, st_adv, st_t), st)
+        out["err"] = jnp.where(guard, jnp.where(exhausted, err_adv, err_t), err)
+        out["outbuf"] = jax.tree.map(
+            lambda a, b: jnp.where(guard, a, b), outbuf_t, outbuf)
+        out["tsize"] = jnp.where(guard, tsize_new, tsize)
+        out["maker"] = _sel(guard, _sel(exhausted, nmaker, maker_traded), maker)
+        out["maker_ptr"] = jnp.where(guard & exhausted, adv_ptr,
+                                     c["maker_ptr"])
+        out["bkey"] = jnp.where(guard & exhausted, new_bkey, c["bkey"])
+        out["blast"] = jnp.where(guard & exhausted, new_blast, c["blast"])
+        out["msb"] = jnp.where(guard & exhausted, new_msb, c["msb"])
+        out["lsb"] = jnp.where(guard & exhausted, new_lsb, c["lsb"])
+        out["done"] = done
+        out["writeback"] = jnp.where(done, ~no_wb & (c["err"] == ERR_OK)
+                                     & (out["err"] == ERR_OK), c["writeback"])
+        out["taker_oid"] = c["taker_oid"]
+        out["taker_aid"] = c["taker_aid"]
+        out["taker_sid"] = c["taker_sid"]
+        out["taker_price"] = c["taker_price"]
+        return out
+
+    carry = {
+        "st": st, "err": err, "outbuf": outbuf, "tsize": taker_size,
+        "maker": maker, "maker_ptr": bfirst, "bkey": bkey, "blast": blast,
+        "msb": msb, "lsb": lsb, "done": empty | (err != ERR_OK),
+        "writeback": jnp.asarray(False),
+        "taker_oid": msg["oid"].astype(_I64), "taker_aid": msg["aid"].astype(_I64),
+        "taker_sid": msg["sid"].astype(_I64),
+        "taker_price": msg["price"].astype(_I32),
+    }
+    # When the book is non-empty the loop runs; a guard-false first
+    # iteration still performs the post-loop writeback (KProcessor.java:259-261).
+    carry["writeback"] = ~empty & (err == ERR_OK)
+    c = jax.lax.while_loop(cond, body, carry)
+
+    st, err, outbuf = c["st"], c["err"], c["outbuf"]
+    # post-loop writeback: buckets[bkey] = (maker_ptr, blast); maker.prev
+    # = null; orders[maker_ptr] = maker (KProcessor.java:259-261)
+    wb = c["writeback"] & (err == ERR_OK)
+    st_wb, err_wb = _bkt_put(st, err, c["bkey"], c["maker_ptr"], c["blast"])
+    rec = dict(c["maker"])
+    rec["ord_prev"] = jnp.asarray(0, _I64)
+    rec["ord_prev_has"] = jnp.asarray(False)
+    st_wb, err_wb = _ord_put(st_wb, err_wb, rec)
+    st = _sel(wb, st_wb, st)
+    err = jnp.where(wb, err_wb, err)
+    # the empty-book early exit returns False unconditionally
+    # (KProcessor.java:232-235), even for a zero-size taker
+    matched = ~empty & (c["tsize"] == 0)
+    return st, err, outbuf, matched, c["tsize"]
+
+
+def _h_trade(st, err, msg, outbuf, java, max_events):
+    """addOrder (KProcessor.java:200-223)."""
+    is_buy = msg["action"] == op.BUY
+    bkey = _order_book_key(msg["sid"], is_buy, java)
+    _, _, book_found = _book_get(st, bkey)
+
+    if java:
+        valid = jnp.asarray(True)
+    else:
+        valid = (msg["price"] >= 0) & (msg["price"] < 126) & (msg["size"] > 0)
+
+    st_cb, err_cb, bal_ok = _check_balance(
+        st, err, msg["aid"], msg["sid"], msg["price"], is_buy, msg["size"], java)
+    pre_ok = valid & book_found & bal_ok
+    st = _sel(valid & book_found, st_cb, st)
+    err = jnp.where(valid & book_found, err_cb, err)
+
+    st_m, err_m, outbuf_m, matched, residual = _try_match(
+        st, err, msg, outbuf, msg["size"].astype(_I32), java, max_events)
+    st = _sel(pre_ok, st_m, st)
+    err = jnp.where(pre_ok, err_m, err)
+    outbuf = jax.tree.map(lambda a, b: jnp.where(pre_ok, a, b), outbuf_m, outbuf)
+    residual = jnp.where(pre_ok, residual, msg["size"].astype(_I32))
+
+    # rest the remainder (KProcessor.java:205-222)
+    rest = pre_ok & ~matched
+    msb, lsb, _ = _book_get(st, bkey)  # reload: tryMatch may have mutated it
+    bit_set = bits.book_check_bit(msb, lsb, msg["price"])
+    bkt_key = bits.bucket_key(bkey, msg["price"])
+    oid64 = msg["oid"].astype(_I64)
+
+    # fresh bucket path: bucket=(oid,oid), set bitmap bit
+    st_f, err_f = _bkt_put(st, err, bkt_key, oid64, oid64)
+    smsb, slsb = bits.book_with_bit_set(msb, lsb, msg["price"])
+    st_f, err_f = _book_put(st_f, err_f, bkey, smsb, slsb)
+    prev_f, prev_has_f = msg["prev"], msg["prev_has"]
+
+    # append path: link onto tail (mutates echoed prev — Q9)
+    bfirst, blast, bfound = _bkt_get(st, bkt_key)
+    err_a = _guard(err, ~bfound, ERR_CRASH)  # NPE: bit set, bucket missing
+    tail, tail_found = _ord_get(st, blast)
+    err_a = _guard(err_a, bfound & ~tail_found, ERR_CRASH)
+    tail_upd = dict(tail)
+    tail_upd["ord_next"] = oid64
+    tail_upd["ord_next_has"] = jnp.asarray(True)
+    st_a, err_a = _ord_put(st, err_a, tail_upd)
+    st_a, err_a = _bkt_put(st_a, err_a, bkt_key, bfirst, oid64)
+    prev_a, prev_has_a = tail["ord_oid"], jnp.asarray(True)
+
+    st_r = _sel(bit_set, st_a, st_f)
+    err_r = jnp.where(bit_set, err_a, err_f)
+    prev_out = jnp.where(bit_set, prev_a, prev_f)
+    prev_has_out = jnp.where(bit_set, prev_has_a, prev_has_f)
+    rec = _order_rec(msg["action"], oid64, msg["aid"], msg["sid"],
+                     msg["price"], residual, msg["next"], msg["next_has"],
+                     prev_out, prev_has_out)
+    st_r, err_r = _ord_put(st_r, err_r, rec)
+
+    st = _sel(rest, st_r, st)
+    err = jnp.where(rest, err_r, err)
+    echo = {"size": residual.astype(_I32),
+            "prev": jnp.where(rest, prev_out, msg["prev"]),
+            "prev_has": jnp.where(rest, prev_has_out, msg["prev_has"])}
+    return st, err, pre_ok, echo, outbuf
+
+
+def _h_cancel(st, err, msg, outbuf, java):
+    """removeOrder (KProcessor.java:289-323): ownership check, 4-case
+    doubly-linked unlink, margin release."""
+    rec, found = _ord_get(st, msg["oid"].astype(_I64))
+    ok = found & (rec["ord_aid"] == msg["aid"].astype(_I64))
+
+    is_buy = rec["ord_action"] == op.BUY
+    bkey = _order_book_key(rec["ord_sid"], is_buy, java)
+    price = rec["ord_price"]
+    msb, lsb, book_found = _book_get(st, bkey)
+    bkt_key = bits.bucket_key(bkey, price)
+    bfirst, blast, bkt_found = _bkt_get(st, bkt_key)
+    has_prev, has_next = rec["ord_prev_has"], rec["ord_next_has"]
+
+    # case only: delete bucket, clear bit (NPE if book missing)
+    err_only = _guard(err, ~book_found, ERR_CRASH)
+    st_only = _bkt_del(st, err_only, bkt_key)
+    umsb, ulsb = bits.book_with_bit_unset(msb, lsb, price)
+    st_only, err_only = _book_put(st_only, err_only, bkey, umsb, ulsb)
+
+    # case head: bucket first = next; next.prev = null (NPE if bucket/next missing)
+    err_head = _guard(err, ~bkt_found, ERR_CRASH)
+    st_head, err_head = _bkt_put(st, err_head, bkt_key, rec["ord_next"], blast)
+    nxt, nxt_found = _ord_get(st, rec["ord_next"])
+    err_head = _guard(err_head, ~nxt_found, ERR_CRASH)
+    nxt_upd = dict(nxt)
+    nxt_upd["ord_prev"] = jnp.asarray(0, _I64)
+    nxt_upd["ord_prev_has"] = jnp.asarray(False)
+    st_head, err_head = _ord_put(st_head, err_head, nxt_upd)
+
+    # case tail: bucket last = prev; prev.next = null
+    err_tail = _guard(err, ~bkt_found, ERR_CRASH)
+    st_tail, err_tail = _bkt_put(st, err_tail, bkt_key, bfirst, rec["ord_prev"])
+    prv, prv_found = _ord_get(st, rec["ord_prev"])
+    err_tail = _guard(err_tail, ~prv_found, ERR_CRASH)
+    prv_upd = dict(prv)
+    prv_upd["ord_next"] = jnp.asarray(0, _I64)
+    prv_upd["ord_next_has"] = jnp.asarray(False)
+    st_tail, err_tail = _ord_put(st_tail, err_tail, prv_upd)
+
+    # case middle: prev.next = next; next.prev = prev
+    prv2, prv2_found = _ord_get(st, rec["ord_prev"])
+    nxt2, nxt2_found = _ord_get(st, rec["ord_next"])
+    err_mid = _guard(err, ~prv2_found | ~nxt2_found, ERR_CRASH)
+    prv2_upd = dict(prv2)
+    prv2_upd["ord_next"] = rec["ord_next"]
+    prv2_upd["ord_next_has"] = jnp.asarray(True)
+    nxt2_upd = dict(nxt2)
+    nxt2_upd["ord_prev"] = rec["ord_prev"]
+    nxt2_upd["ord_prev_has"] = jnp.asarray(True)
+    st_mid, err_mid = _ord_put(st, err_mid, prv2_upd)
+    st_mid, err_mid = _ord_put(st_mid, err_mid, nxt2_upd)
+
+    st_u = _sel(has_prev,
+                _sel(has_next, st_mid, st_tail),
+                _sel(has_next, st_head, st_only))
+    err_u = jnp.where(has_prev,
+                      jnp.where(has_next, err_mid, err_tail),
+                      jnp.where(has_next, err_head, err_only))
+
+    st_u = _ord_del(st_u, err_u, msg["oid"].astype(_I64))
+    st_u, err_u = _post_remove_adjustments(st_u, err_u, rec, java)
+
+    st = _sel(ok, st_u, st)
+    err = jnp.where(ok, err_u, err)
+    return st, err, ok, _echo_of(msg), outbuf
+
+
+def _remove_all_orders_java(st, err, book_key):
+    """removeAllOrders java semantics (KProcessor.java:335-357, Q4): any
+    non-empty book loops forever -> ERR_HANG. Returns (err, exists)."""
+    msb, lsb, found = _book_get(st, book_key)
+    nonempty = bits.book_min_price(msb, lsb) != -1
+    err = _guard(err, found & nonempty, ERR_HANG)
+    return err, found
+
+
+def _wipe_book_fixed(st, err, book_key, java, max_iters):
+    """Fixed-mode book wipe (oracle._wipe_book_fixed): pop every bucket,
+    release margin for every resting order, clear the bitmap."""
+    msb, lsb, found = _book_get(st, book_key)
+
+    def cond(c):
+        return (c["err"] == ERR_OK) & ~c["done"]
+
+    def body(c):
+        st, err = c["st"], c["err"]
+        # fetch level head if not walking a list
+        price = jnp.where(c["walking"], c["price"],
+                          bits.book_min_price(c["msb"], c["lsb"]))
+        level_done = ~c["walking"] & (price == -1)
+
+        bkey = bits.bucket_key(book_key, price)
+        bfirst, _, bfound = _bkt_get(st, bkey)
+        # entering a level: pop bucket (oracle .pop raises when missing)
+        entering = ~c["walking"] & ~level_done
+        err_e = _guard(err, entering & ~bfound, ERR_CRASH)
+        st_e = _sel(entering, _bkt_del(st, err_e, bkey), st)
+
+        ptr = jnp.where(c["walking"], c["ptr"], bfirst)
+        rec, rfound = _ord_get(st_e, ptr)
+        act = ~level_done
+        err_e = _guard(err_e, act & ~rfound, ERR_CRASH)
+        st_o = _ord_del(st_e, err_e, ptr)
+        st_o, err_o = _post_remove_adjustments(st_o, err_e, rec, java)
+        st_n = _sel(act, st_o, st_e)
+        err_n = jnp.where(act, err_o, err_e)
+
+        walking_next = act & rec["ord_next_has"]
+        # level finished: clear bit
+        level_end = act & ~rec["ord_next_has"]
+        nmsb, nlsb = bits.book_with_bit_unset(c["msb"], c["lsb"], price)
+        out = {
+            "st": st_n, "err": err_n,
+            "msb": jnp.where(level_end, nmsb, c["msb"]),
+            "lsb": jnp.where(level_end, nlsb, c["lsb"]),
+            "walking": walking_next,
+            "ptr": jnp.where(walking_next, rec["ord_next"], 0).astype(_I64),
+            "price": price.astype(_I32),
+            "done": level_done,
+            "iters": c["iters"] + 1,
+        }
+        out["err"] = _guard(out["err"], out["iters"] >= max_iters, ERR_CRASH)
+        return out
+
+    carry = {"st": st, "err": err, "msb": msb, "lsb": lsb,
+             "walking": jnp.asarray(False), "ptr": jnp.asarray(0, _I64),
+             "price": jnp.asarray(-1, _I32), "done": ~found,
+             "iters": jnp.asarray(0, _I32)}
+    c = jax.lax.while_loop(cond, body, carry)
+    st, err = c["st"], c["err"]
+    st2, err2 = _book_put(st, err, book_key, c["msb"], c["lsb"])
+    st = _sel(found, st2, st)
+    err = jnp.where(found, err2, err)
+    return st, err
+
+
+def _h_remove_symbol(st, err, msg, outbuf, java, max_iters):
+    """removeSymbol (KProcessor.java:193-198). Java: Q3 inverted return +
+    Q4 hang; short-circuit `or` replicated. Fixed: wipe + delete, True."""
+    sid = msg["sid"].astype(_I64)
+    if java:
+        err1, exists1 = _remove_all_orders_java(st, err, sid)
+        # short-circuit: -sid side only evaluated when +sid side absent
+        # (KProcessor.java:194 `if (a || b)`) — its hang can't fire then
+        err2, exists2 = _remove_all_orders_java(st, err1, -sid)
+        err_sc = jnp.where(exists1, err1, err2)
+        ok = ~exists1 & ~exists2
+        st_p = _book_del(st, err_sc, sid)
+        st_p = _book_del(st_p, err_sc, -sid)
+        st = _sel(ok, st_p, st)
+        return st, err_sc, ok, _echo_of(msg), outbuf
+    s = jnp.abs(sid)
+    k1, k2 = 2 * s, 2 * s + 1
+    _, _, found = _book_get(st, k1)
+    st_w, err_w = _wipe_book_fixed(st, err, k1, java, max_iters)
+    st_w, err_w = _wipe_book_fixed(st_w, err_w, k2, java, max_iters)
+    st_w = _book_del(st_w, err_w, k1)
+    st_w = _book_del(st_w, err_w, k2)
+    st = _sel(found, st_w, st)
+    err = jnp.where(found, err_w, err)
+    return st, err, found, _echo_of(msg), outbuf
+
+
+def _h_payout(st, err, msg, outbuf, java, max_iters):
+    """payout (KProcessor.java:148-165): removeSymbol, then credit
+    `amount * order.size` per matching position and delete it (vectorized
+    over the positions table — order-insensitive since mod-2^64 adds
+    commute). Java: Q3 makes this reachable only for missing books; Q5/Q6
+    the result is discarded by the dispatcher. Fixed: sid>=0 YES credits
+    longs, sid<0 NO deletes uncredited."""
+    st, err, removed, _, outbuf = _h_remove_symbol(
+        st, err, msg, outbuf, java, max_iters)
+
+    sid = msg["sid"].astype(_I64)
+    match_sid = sid if java else jnp.abs(sid)
+    credit = jnp.asarray(True) if java else sid >= 0
+
+    pmask = st["pos_used"] & (st["pos_ks"] == match_sid)
+    # per-balance-slot credit: sum over matching positions owned by that key
+    owner = st["pos_ka"][:, None] == st["bal_key"][None, :]
+    hit = pmask[:, None] & owner & st["bal_used"][None, :]
+    credit_amt = jnp.sum(
+        jnp.where(hit, st["pos_amt"][:, None] * msg["size"].astype(_I64), 0),
+        axis=0)
+    orphan = pmask & ~jnp.any(hit, axis=1)  # NPE: position w/o balance
+    do = removed & (err == ERR_OK)
+    err = _guard(err, do & credit & jnp.any(orphan), ERR_CRASH)
+    apply = do & credit & (err == ERR_OK)
+    st = dict(st)
+    st["bal_val"] = jnp.where(apply, st["bal_val"] + credit_amt, st["bal_val"])
+    st["pos_used"] = jnp.where(do & (err == ERR_OK),
+                               st["pos_used"] & ~pmask, st["pos_used"])
+    return st, err, removed, _echo_of(msg), outbuf
+
+
+# ---------------------------------------------------------------------------
+# dispatch + scan
+
+def _dense_op(action, pad):
+    """Wire action -> dense branch index. 0 = pad/no-op (explicit flag)."""
+    table = [
+        (op.ADD_SYMBOL, 1), (op.REMOVE_SYMBOL, 2), (op.BUY, 3), (op.SELL, 3),
+        (op.CANCEL, 4), (op.CREATE_BALANCE, 5), (op.TRANSFER, 6),
+        (op.PAYOUT, 7),
+    ]
+    out = jnp.asarray(8, _I32)  # unknown -> reject
+    for wire, dense in table:
+        out = jnp.where(action == wire, jnp.asarray(dense, _I32), out)
+    return jnp.where(pad, jnp.asarray(0, _I32), out)
+
+
+@functools.lru_cache(maxsize=None)
+def build_step(caps: ParityCaps, compat: str):
+    """Build the jitted batch step: (state, msgs) -> (state, outputs).
+
+    Cached per (caps, compat) so every ParityEngine with the same shape
+    shares one compiled XLA program.
+
+    msgs: dict of (T,)-arrays. outputs: dict of per-message results
+    (result, action_out, size_out, prev_out, prev_has_out, events,
+    n_events, err)."""
+    java = compat == "java"
+    E = caps.max_events
+    max_iters = caps.orders + 130
+
+    def one_message(st, err, msg):
+        outbuf = (jnp.zeros((E, 6), _I64), jnp.asarray(0, _I32))
+
+        def b_pad(a):
+            st, err, msg, outbuf = a
+            return st, err, jnp.asarray(True), _echo_of(msg), outbuf
+
+        def b_add_symbol(a):
+            return _h_add_symbol(*a, java)
+
+        def b_remove_symbol(a):
+            st, err, msg, outbuf = a
+            return _h_remove_symbol(st, err, msg, outbuf, java, max_iters)
+
+        def b_trade(a):
+            st, err, msg, outbuf = a
+            return _h_trade(st, err, msg, outbuf, java, E)
+
+        def b_cancel(a):
+            return _h_cancel(*a, java)
+
+        def b_create_balance(a):
+            return _h_create_balance(*a, java)
+
+        def b_transfer(a):
+            return _h_transfer(*a, java)
+
+        def b_payout(a):
+            st, err, msg, outbuf = a
+            st, err, r, echo, outbuf = _h_payout(st, err, msg, outbuf, java,
+                                                 max_iters)
+            # Q5/Q6: java discards payout's result (KProcessor.java:113-115)
+            return st, err, (jnp.asarray(False) if java else r), echo, outbuf
+
+        def b_unknown(a):
+            st, err, msg, outbuf = a
+            return st, err, jnp.asarray(False), _echo_of(msg), outbuf
+
+        branches = [b_pad, b_add_symbol, b_remove_symbol, b_trade, b_cancel,
+                    b_create_balance, b_transfer, b_payout, b_unknown]
+        dense = _dense_op(msg["action"], msg["pad"])
+        st, err, result, echo, outbuf = jax.lax.switch(
+            dense, branches, (st, err, msg, outbuf))
+        is_pad = dense == 0
+        # REJECT rewrite (KProcessor.java:123)
+        action_out = jnp.where(result, msg["action"], jnp.asarray(op.REJECT, _I32))
+        return st, err, {
+            "result": result & ~is_pad,
+            "pad": is_pad,
+            "action_out": jnp.where(is_pad, msg["action"], action_out),
+            "size_out": echo["size"].astype(_I32),
+            "prev_out": echo["prev"].astype(_I64),
+            "prev_has_out": echo["prev_has"],
+            "events": outbuf[0],
+            "n_events": outbuf[1],
+            "err": err,
+        }
+
+    def scan_body(carry, msg):
+        st, err = carry
+        # sticky error: freeze all processing after the first failure
+        st2, err2, out = one_message(st, err, msg)
+        frozen = err != ERR_OK
+        st = _sel(frozen, st, st2)
+        err = jnp.where(frozen, err, err2)
+        out = jax.tree.map(lambda x: jnp.where(frozen, jnp.zeros_like(x), x), out)
+        out["err"] = err
+        return (st, err), out
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, msgs):
+        (state, err), outs = jax.lax.scan(
+            scan_body, (state, jnp.asarray(ERR_OK, _I32)), msgs)
+        return state, outs
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# host wrapper
+
+def _msgs_to_arrays(msgs: Sequence[OrderMsg], batch: int) -> Dict[str, np.ndarray]:
+    from kme_tpu.oracle import javalong as jl
+
+    T = batch
+    arr = {
+        "action": np.full(T, NOP_PAD, np.int32),
+        "pad": np.ones(T, bool),
+        "oid": np.zeros(T, np.int64), "aid": np.zeros(T, np.int64),
+        "sid": np.zeros(T, np.int64), "price": np.zeros(T, np.int32),
+        "size": np.zeros(T, np.int32),
+        "next": np.zeros(T, np.int64), "next_has": np.zeros(T, bool),
+        "prev": np.zeros(T, np.int64), "prev_has": np.zeros(T, bool),
+    }
+    for i, m in enumerate(msgs):
+        arr["pad"][i] = False
+        arr["action"][i] = jl.jint(m.action)
+        arr["oid"][i] = jl.jlong(m.oid)
+        arr["aid"][i] = jl.jlong(m.aid)
+        arr["sid"][i] = jl.jlong(m.sid)
+        arr["price"][i] = jl.jint(m.price)
+        arr["size"][i] = jl.jint(m.size)
+        if m.next is not None:
+            arr["next"][i] = jl.jlong(m.next)
+            arr["next_has"][i] = True
+        if m.prev is not None:
+            arr["prev"][i] = jl.jlong(m.prev)
+            arr["prev_has"][i] = True
+    return arr
+
+
+class ParityEngine:
+    """Host wrapper: the drop-in device-backed equivalent of OracleEngine.
+
+    process()/process_batch() return the same OutRecord stream the oracle
+    produces (IN echo, fills, OUT echo per message —
+    KProcessor.java:97, 272-273, 124). On a reference-death path it
+    raises DeviceParityError after emitting the records of every message
+    before the death point."""
+
+    def __init__(self, compat: str = "java",
+                 caps: Optional[ParityCaps] = None) -> None:
+        if compat not in ("java", "fixed"):
+            raise ValueError(compat)
+        self.compat = compat
+        self.caps = caps or ParityCaps()
+        self.state = make_state(self.caps)
+        self._step = build_step(self.caps, compat)
+
+    def process(self, msg: OrderMsg) -> List[OutRecord]:
+        return self.process_batch([msg])[0]
+
+    def process_batch(self, msgs: Sequence[OrderMsg]) -> List[List[OutRecord]]:
+        """Process messages strictly in order; returns per-message record
+        lists."""
+        out: List[List[OutRecord]] = []
+        for lo in range(0, len(msgs), self.caps.batch):
+            chunk = list(msgs[lo:lo + self.caps.batch])
+            arrs = _msgs_to_arrays(chunk, self.caps.batch)
+            self.state, outs = self._step(self.state, arrs)
+            outs = jax.tree.map(np.asarray, outs)
+            for i, m in enumerate(chunk):
+                if outs["err"][i] != ERR_OK:
+                    raise DeviceParityError(outs["err"][i], lo + i, out)
+                out.append(self._records(m, outs, i))
+        return out
+
+    @staticmethod
+    def _records(m: OrderMsg, outs, i: int) -> List[OutRecord]:
+        recs = [OutRecord("IN", m.copy())]
+        for e in range(int(outs["n_events"][i])):
+            a, oid, aid, sid, price, size = (int(x) for x in outs["events"][i, e])
+            recs.append(OutRecord("OUT", OrderMsg(
+                action=a, oid=oid, aid=aid, sid=sid, price=price, size=size)))
+        echo = m.copy()
+        echo.action = int(outs["action_out"][i])
+        echo.size = int(outs["size_out"][i])
+        if bool(outs["prev_has_out"][i]):
+            echo.prev = int(outs["prev_out"][i])
+        else:
+            echo.prev = None
+        recs.append(OutRecord("OUT", echo))
+        return recs
+
+    # -- state export for deep-equality tests ---------------------------------
+
+    def export_state(self) -> Dict[str, dict]:
+        """Host-side dict view of the five stores, directly comparable to
+        the oracle's dicts."""
+        s = jax.tree.map(np.asarray, self.state)
+        balances = {int(k): int(v) for k, v, u in
+                    zip(s["bal_key"], s["bal_val"], s["bal_used"]) if u}
+        positions = {}
+        for ka, ks, amt, av, u in zip(s["pos_ka"], s["pos_ks"], s["pos_amt"],
+                                      s["pos_avail"], s["pos_used"]):
+            if u:
+                positions[(int(ka), int(ks))] = (int(amt), int(av))
+        books = {}
+        for k, msb, lsb, u in zip(s["book_key"], s["book_msb"], s["book_lsb"],
+                                  s["book_used"]):
+            if u:
+                books[int(k)] = (int(msb), int(lsb))
+        buckets = {}
+        for k, f, l, u in zip(s["bkt_key"], s["bkt_first"], s["bkt_last"],
+                              s["bkt_used"]):
+            if u:
+                buckets[int(k)] = (int(f), int(l))
+        orders = {}
+        for i in range(len(s["ord_oid"])):
+            if s["ord_used"][i]:
+                orders[int(s["ord_oid"][i])] = {
+                    "action": int(s["ord_action"][i]),
+                    "aid": int(s["ord_aid"][i]), "sid": int(s["ord_sid"][i]),
+                    "price": int(s["ord_price"][i]),
+                    "size": int(s["ord_size"][i]),
+                    "next": int(s["ord_next"][i]) if s["ord_next_has"][i] else None,
+                    "prev": int(s["ord_prev"][i]) if s["ord_prev_has"][i] else None,
+                }
+        return {"balances": balances, "positions": positions, "books": books,
+                "buckets": buckets, "orders": orders}

@@ -155,7 +155,7 @@ class OracleEngine:
         of sid=0 (Q1). Fixed: 2*sid + side, always disjoint."""
         if self.java:
             return jl.jmul(sid, 1 if is_buy else -1)
-        return 2 * sid + (0 if is_buy else 1)
+        return jl.jlong(2 * sid + (0 if is_buy else 1))
 
     def _bucket_key(self, book_key: int, price: int) -> int:
         """getBucketPointer (KProcessor.java:379-381): (key << 8) | price
@@ -164,7 +164,7 @@ class OracleEngine:
         base-256 packing is exact."""
         if self.java:
             return jl.jor(jl.jshl(book_key, 8), jl.jlong(price))
-        return book_key * 256 + price
+        return jl.jlong(book_key * 256 + price)
 
     # ------------------------------------------------------------------
     # public entry
@@ -229,10 +229,10 @@ class OracleEngine:
             self.books[jl.jlong(sid)] = (0, 0)
             self.books[jl.jneg(sid)] = (0, 0)
             return True
-        if sid < 0 or 2 * sid in self.books:
+        if sid < 0 or jl.jlong(2 * sid) in self.books:
             return False
-        self.books[2 * sid] = (0, 0)
-        self.books[2 * sid + 1] = (0, 0)
+        self.books[jl.jlong(2 * sid)] = (0, 0)
+        self.books[jl.jlong(2 * sid + 1)] = (0, 0)
         return True
 
     def _remove_symbol(self, sid: int) -> bool:
@@ -248,12 +248,13 @@ class OracleEngine:
             self.books.pop(jl.jneg(sid), None)
             return True
         s = abs(sid)
-        if 2 * s not in self.books:
+        k_buy, k_sell = jl.jlong(2 * s), jl.jlong(2 * s + 1)
+        if k_buy not in self.books:
             return False
-        self._wipe_book_fixed(2 * s)
-        self._wipe_book_fixed(2 * s + 1)
-        del self.books[2 * s]
-        del self.books[2 * s + 1]
+        self._wipe_book_fixed(k_buy)
+        self._wipe_book_fixed(k_sell)
+        del self.books[k_buy]
+        del self.books[k_sell]
         return True
 
     def _remove_all_orders_java(self, book_key: int) -> bool:
@@ -280,10 +281,14 @@ class OracleEngine:
         price = _book_min_price(book)
         while price != -1:
             bucket_key = self._bucket_key(book_key, price)
-            bucket = self.buckets.pop(bucket_key)
+            bucket = self.buckets.pop(bucket_key, None)
+            if bucket is None:
+                raise ReferenceCrash("NPE: bitmap bit set but bucket missing")
             ptr: Optional[int] = bucket[0]
             while ptr is not None:
-                rec = self.orders.pop(ptr)
+                rec = self.orders.pop(ptr, None)
+                if rec is None:
+                    raise ReferenceCrash("NPE: linked order missing in wipe")
                 self._post_remove_adjustments(rec)
                 ptr = rec.next
             book = _with_bit_unset(book, price)
@@ -302,7 +307,7 @@ class OracleEngine:
         (positions deleted uncredited)."""
         if not self._remove_symbol(order.sid):
             return False
-        match_sid = jl.jlong(order.sid) if self.java else abs(order.sid)
+        match_sid = jl.jlong(order.sid) if self.java else jl.jlong(abs(order.sid))
         credit = self.java or order.sid >= 0
         to_remove = []
         for key, val in self.positions.items():
@@ -337,11 +342,16 @@ class OracleEngine:
         size = jl.jint(order.size * (1 if is_buy else -1))
         pos = self.positions.get((aid, order.sid))
         available = pos[1] if pos is not None else 0
+        # `-size` is Java int negation (wraps for INT_MIN) promoted to long
+        neg_size = jl.jint(-size)
         if is_buy:
-            adj = max(min(available, 0), -size)
+            adj = max(min(available, 0), neg_size)
         else:
-            adj = min(max(available, 0), -size)
-        risk = jl.jmul(jl.jadd(size, adj), order.price if is_buy else order.price - 100)
+            adj = min(max(available, 0), neg_size)
+        # the margin unit `price - 100` is computed in 32-bit int before
+        # promotion to long for the multiply (KProcessor.java:176)
+        risk = jl.jmul(jl.jadd(size, adj),
+                       jl.jint(order.price) if is_buy else jl.jint(order.price - 100))
         if bal < risk:
             return False
         self.balances[aid] = jl.jadd(bal, -risk)
@@ -359,15 +369,17 @@ class OracleEngine:
         size = jl.jint(rec.size * (1 if is_buy else -1))
         pos = self.positions.get((rec.aid, rec.sid))
         blocked = (pos[0] - pos[1]) if pos is not None else 0
+        neg_size = jl.jint(-size)  # Java int negation, as in checkBalance
         if is_buy:
-            adj = max(min(blocked, 0), -size)
+            adj = max(min(blocked, 0), neg_size)
         else:
-            adj = min(max(blocked, 0), -size)
+            adj = min(max(blocked, 0), neg_size)
         bal = self.balances.get(rec.aid)
         if bal is None:
             raise ReferenceCrash("NPE: margin release for account with no balance")
         self.balances[rec.aid] = jl.jadd(
-            bal, jl.jmul(jl.jadd(size, adj), rec.price if is_buy else rec.price - 100))
+            bal, jl.jmul(jl.jadd(size, adj),
+                         jl.jint(rec.price) if is_buy else jl.jint(rec.price - 100)))
         if adj != 0:
             target = pos if self.java else (rec.aid, rec.sid)  # Q11
             self.positions[target] = (pos[0], jl.jadd(pos[1], adj))
@@ -455,9 +467,11 @@ class OracleEngine:
             self._execute_trade(taker, maker, trade_size, taker_is_buy)
             if maker.size != 0:
                 break
-            del self.orders[maker.oid]
+            # store.delete is a no-op on missing keys (RocksDB semantics,
+            # KProcessor.java:243,245) — hence pop(..., None), not del
+            self.orders.pop(maker.oid, None)
             if maker.next is None:
-                del self.buckets[bucket_key]
+                self.buckets.pop(bucket_key, None)
                 bitmap = _with_bit_unset(bitmap, maker.price)
                 self.books[opp_key] = bitmap
                 price_bit = (
@@ -566,7 +580,7 @@ class OracleEngine:
         if prev_ptr is None and next_ptr is None:
             if book is None:
                 raise ReferenceCrash("NPE: book missing in removeOrder")
-            del self.buckets[bucket_key]
+            self.buckets.pop(bucket_key, None)  # store.delete: no-op if absent
             self.books[bkey] = _with_bit_unset(book, price)
         elif prev_ptr is None:
             self.buckets[bucket_key] = (next_ptr, bucket[1])
@@ -585,6 +599,6 @@ class OracleEngine:
             nxt.prev = prev_ptr
             self.orders[prev_ptr] = prv
             self.orders[next_ptr] = nxt
-        del self.orders[oid]
+        self.orders.pop(oid, None)  # store.delete: no-op if absent
         self._post_remove_adjustments(rec)
         return True

@@ -1,0 +1,262 @@
+"""Device parity engine vs the scalar oracle — the central correctness
+claim of the framework (SURVEY.md §4): for every message stream in the
+Jackson envelope, the device engine's output record stream and store
+state equal the oracle's byte for byte, in both compat modes, and
+reference-death paths surface at the same message index.
+"""
+
+import pytest
+
+import kme_tpu.opcodes as op
+from kme_tpu.engine.parity import (
+    ERR_CRASH, ERR_HANG, ERR_TABLE_FULL, DeviceParityError, ParityCaps,
+    ParityEngine)
+from kme_tpu.oracle import OracleEngine
+from kme_tpu.wire import OrderMsg
+from kme_tpu.workload import harness_stream
+
+CAPS = ParityCaps(balances=16, positions=1024, books=16, buckets=256,
+                  orders=2048, max_events=32, batch=128)
+
+
+def run_oracle(msgs, compat):
+    """-> (list of wire-line lists per message, death index or None)."""
+    eng = OracleEngine(compat)
+    recs, death = [], None
+    for i, m in enumerate(msgs):
+        try:
+            recs.append([r.wire() for r in eng.process(m.copy())])
+        except Exception:  # ReferenceHang/Crash and dict/None-access deaths
+            death = i
+            break
+    return recs, death, eng
+
+
+def run_device(msgs, compat, caps=CAPS):
+    eng = ParityEngine(compat, caps)
+    try:
+        out = eng.process_batch(msgs)
+        return [[r.wire() for r in recs] for recs in out], None, eng
+    except DeviceParityError as e:
+        return [[r.wire() for r in recs] for recs in e.records], e.index, eng
+
+
+def oracle_state(ora: OracleEngine):
+    orders = {oid: {"action": r.action, "aid": r.aid, "sid": r.sid,
+                    "price": r.price, "size": r.size, "next": r.next,
+                    "prev": r.prev}
+              for oid, r in ora.orders.items()}
+    return {"balances": dict(ora.balances), "positions": dict(ora.positions),
+            "books": dict(ora.books), "buckets": dict(ora.buckets),
+            "orders": orders}
+
+
+def assert_parity(msgs, compat, caps=CAPS, check_state=True):
+    ora_recs, ora_death, ora = run_oracle(msgs, compat)
+    dev_recs, dev_death, dev = run_device(msgs, compat, caps)
+    assert dev_death == ora_death, (
+        f"death index diverged: device={dev_death} oracle={ora_death}")
+    assert len(dev_recs) == len(ora_recs)
+    for i, (g, w) in enumerate(zip(dev_recs, ora_recs)):
+        assert g == w, f"record stream diverged at message {i}: {msgs[i]}"
+    if check_state and ora_death is None:
+        assert dev.export_state() == oracle_state(ora)
+    return ora, dev
+
+
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_parity_java_stock_workload():
+    """The reference harness distribution (exchange_test.js), java compat:
+    exercises Q1 (sid-0 trades), Q2 (unclamped sizes), Q9 (prev leaks),
+    Q11 (position garbage keys) on 1.5k events."""
+    assert_parity(harness_stream(1500, seed=0), "java")
+
+
+@pytest.mark.slow
+def test_parity_fixed_stock_workload():
+    """Fixed mode on the validated-domain workload with real PAYOUT
+    opcodes (payout bug disabled)."""
+    assert_parity(
+        harness_stream(1500, seed=3, payout_opcode_bug=False, validate=True),
+        "fixed")
+
+
+def _seeded(num_accounts=4, deposit=200_000, symbols=(1, 2)):
+    msgs = []
+    for a in range(num_accounts):
+        msgs.append(OrderMsg(action=op.CREATE_BALANCE, aid=a))
+        msgs.append(OrderMsg(action=op.TRANSFER, aid=a, size=deposit))
+    for s in symbols:
+        msgs.append(OrderMsg(action=op.ADD_SYMBOL, sid=s))
+    return msgs
+
+
+def test_parity_payout_and_remove_symbol_fixed():
+    """Dense coverage of the fixed-mode settlement paths: resting books
+    wiped with margin release, YES/NO payouts, re-add after removal."""
+    msgs = _seeded()
+    oid = 100
+    for sid in (1, 2):
+        for price, size in ((40, 5), (40, 3), (55, 7), (60, 2)):
+            msgs.append(OrderMsg(action=op.BUY, oid=oid, aid=oid % 4, sid=sid,
+                                 price=price, size=size))
+            oid += 1
+        for price, size in ((70, 4), (80, 6)):
+            msgs.append(OrderMsg(action=op.SELL, oid=oid, aid=oid % 4, sid=sid,
+                                 price=price, size=size))
+            oid += 1
+    # cross some orders to create positions
+    msgs.append(OrderMsg(action=op.BUY, oid=oid, aid=3, sid=1, price=75, size=5))
+    msgs.append(OrderMsg(action=op.SELL, oid=oid + 1, aid=2, sid=2, price=35, size=6))
+    msgs += [
+        OrderMsg(action=op.PAYOUT, sid=1, size=97),    # YES: credit longs
+        OrderMsg(action=op.PAYOUT, sid=-2, size=97),   # NO: delete uncredited
+        OrderMsg(action=op.PAYOUT, sid=1, size=97),    # symbol gone -> reject
+        OrderMsg(action=op.ADD_SYMBOL, sid=1),         # re-add after payout
+        OrderMsg(action=op.REMOVE_SYMBOL, sid=1),      # empty remove
+        OrderMsg(action=op.REMOVE_SYMBOL, sid=9),      # missing -> reject
+    ]
+    assert_parity(msgs, "fixed")
+
+
+def test_parity_java_remove_symbol_quirks():
+    """Q3: removeSymbol on existing-but-empty books rejects (inverted);
+    on missing books succeeds."""
+    msgs = _seeded(symbols=(1,))
+    msgs += [
+        OrderMsg(action=op.REMOVE_SYMBOL, sid=1),  # exists+empty -> REJECT (Q3)
+        OrderMsg(action=op.REMOVE_SYMBOL, sid=5),  # missing -> "success"
+        OrderMsg(action=op.ADD_SYMBOL, sid=1),     # still exists -> reject
+    ]
+    assert_parity(msgs, "java")
+
+
+def test_parity_java_hang_on_nonempty_remove():
+    """Q4: REMOVE_SYMBOL with resting orders = the reference's infinite
+    loop; both engines must die at the same message index."""
+    msgs = _seeded(symbols=(1,))
+    msgs.append(OrderMsg(action=op.BUY, oid=7, aid=0, sid=1, price=40, size=5))
+    msgs.append(OrderMsg(action=op.REMOVE_SYMBOL, sid=1))
+    ora_recs, ora_death, _ = run_oracle(msgs, "java")
+    dev_recs, dev_death, dev = run_device(msgs, "java")
+    assert ora_death == dev_death == len(msgs) - 1
+    assert dev_recs == ora_recs
+    with pytest.raises(DeviceParityError) as ei:
+        ParityEngine("java", CAPS).process_batch(msgs)
+    assert ei.value.code == ERR_HANG
+
+
+def test_parity_java_payout_credits_on_missing_books():
+    """Q3+Q5/Q6 interplay: java PAYOUT proceeds only when the symbol's
+    books are MISSING, crediting any stale positions — and the OUT echo
+    is still REJECT because the dispatcher drops the result."""
+    msgs = _seeded(symbols=(1,))
+    # create a position on symbol 1 via a cross
+    msgs.append(OrderMsg(action=op.BUY, oid=1, aid=0, sid=1, price=50, size=4))
+    msgs.append(OrderMsg(action=op.SELL, oid=2, aid=1, sid=1, price=50, size=4))
+    # cancel nothing; payout sid=3 (books missing): succeeds internally,
+    # echo REJECT; no positions match sid 3 so nothing credited
+    msgs.append(OrderMsg(action=op.PAYOUT, sid=3, size=97))
+    ora, dev = assert_parity(msgs, "java")
+    # position on (aid, sid=1) survived; balances unchanged by the payout
+    assert any(k[1] == 1 for k in ora.positions)
+
+
+def test_parity_q1_sid0_merged_book():
+    """Q1: symbol 0's buy and sell sides share one book; a buy can match
+    a resting buy."""
+    msgs = _seeded(symbols=(0,))
+    msgs.append(OrderMsg(action=op.BUY, oid=1, aid=0, sid=0, price=40, size=5))
+    msgs.append(OrderMsg(action=op.BUY, oid=2, aid=1, sid=0, price=45, size=5))
+    msgs.append(OrderMsg(action=op.SELL, oid=3, aid=2, sid=0, price=80, size=2))
+    msgs.append(OrderMsg(action=op.SELL, oid=4, aid=3, sid=0, price=10, size=2))
+    ora, dev = assert_parity(msgs, "java")
+
+
+def test_parity_q2_ghost_trades():
+    """Q2: a fully-filled sell taker still executes one zero-size trade
+    when the next maker crosses; zero-size orders behave asymmetrically."""
+    msgs = _seeded(symbols=(1,))
+    msgs.append(OrderMsg(action=op.BUY, oid=1, aid=0, sid=1, price=50, size=3))
+    msgs.append(OrderMsg(action=op.BUY, oid=2, aid=1, sid=1, price=50, size=3))
+    # sell exactly 3: fills vs oid 1, then ghost zero-size trade vs oid 2
+    msgs.append(OrderMsg(action=op.SELL, oid=3, aid=2, sid=1, price=40, size=3))
+    # zero-size buy rests/not per crossing rules
+    msgs.append(OrderMsg(action=op.BUY, oid=4, aid=3, sid=1, price=10, size=0))
+    ora, dev = assert_parity(msgs, "java")
+    # confirm the ghost trade actually happened (size-0 fills emitted)
+    eng = OracleEngine("java")
+    ghost = 0
+    for m in msgs:
+        for r in eng.process(m.copy()):
+            if r.key == "OUT" and r.value.action in (op.BOUGHT, op.SOLD) \
+                    and r.value.size == 0:
+                ghost += 1
+    assert ghost >= 2
+
+
+def test_parity_q9_prev_leak_and_residual_echo():
+    """Q9: the OUT echo of a rested order appended to a bucket carries
+    the tail's oid in `prev`; a partially-filled taker echoes residual
+    size."""
+    msgs = _seeded(symbols=(1,))
+    msgs.append(OrderMsg(action=op.BUY, oid=1, aid=0, sid=1, price=50, size=3))
+    msgs.append(OrderMsg(action=op.BUY, oid=2, aid=1, sid=1, price=50, size=3))
+    msgs.append(OrderMsg(action=op.SELL, oid=3, aid=2, sid=1, price=45, size=10))
+    _, dev = assert_parity(msgs, "java")
+    # device echo of msg 2 (append path) must carry prev=1
+    out = ParityEngine("java", CAPS).process_batch(msgs)
+    echo2 = out[len(msgs) - 2][-1].value
+    assert echo2.prev == 1
+    echo3 = out[len(msgs) - 1][-1].value
+    assert echo3.size == 10 - 6  # residual after sweeping both makers
+
+
+def test_parity_cancel_all_link_cases():
+    """Cancel only/head/tail/middle unlink cases + margin release, and
+    cancels of unknown/foreign oids."""
+    msgs = _seeded(symbols=(1,))
+    for i, (price, size) in enumerate(
+            ((50, 1), (50, 2), (50, 3), (50, 4), (50, 5))):
+        msgs.append(OrderMsg(action=op.BUY, oid=10 + i, aid=i % 4, sid=1,
+                             price=price, size=size))
+    msgs += [
+        OrderMsg(action=op.CANCEL, oid=12, aid=2),   # middle
+        OrderMsg(action=op.CANCEL, oid=10, aid=0),   # head
+        OrderMsg(action=op.CANCEL, oid=14, aid=0),   # tail, wrong owner
+        OrderMsg(action=op.CANCEL, oid=14, aid=3),   # tail
+        OrderMsg(action=op.CANCEL, oid=999, aid=0),  # unknown
+        OrderMsg(action=op.CANCEL, oid=11, aid=1),   # head again
+        OrderMsg(action=op.CANCEL, oid=13, aid=3),   # only
+        OrderMsg(action=op.CANCEL, oid=13, aid=3),   # already gone
+    ]
+    assert_parity(msgs, "java")
+    assert_parity(msgs, "fixed")
+
+
+def test_device_capacity_overflow_is_flagged():
+    tiny = ParityCaps(balances=2, positions=8, books=4, buckets=8,
+                      orders=8, max_events=8, batch=16)
+    msgs = [OrderMsg(action=op.CREATE_BALANCE, aid=a) for a in range(3)]
+    with pytest.raises(DeviceParityError) as ei:
+        ParityEngine("java", tiny).process_batch(msgs)
+    assert ei.value.code == ERR_TABLE_FULL
+    assert ei.value.index == 2
+
+
+def test_parity_transfer_and_balance_edges():
+    msgs = [
+        OrderMsg(action=op.TRANSFER, aid=1, size=100),   # no account -> reject
+        OrderMsg(action=op.CREATE_BALANCE, aid=1),
+        OrderMsg(action=op.CREATE_BALANCE, aid=1),       # duplicate -> reject
+        OrderMsg(action=op.TRANSFER, aid=1, size=500),
+        OrderMsg(action=op.TRANSFER, aid=1, size=-500),  # to exactly 0
+        OrderMsg(action=op.TRANSFER, aid=1, size=-1),    # overdraft -> reject
+        OrderMsg(action=op.TRANSFER, aid=1, size=0),
+        OrderMsg(action=op.BUY, oid=1, aid=1, sid=9, price=50, size=1),  # no book
+        OrderMsg(action=99, oid=1, aid=1),               # unknown opcode
+    ]
+    assert_parity(msgs, "java")
+    assert_parity(msgs, "fixed")
