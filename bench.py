@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""Driver benchmark entry: prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N} on stdout.
+
+Details go to stderr. Run on the active backend (real TPU under the
+driver). See kme_tpu/benchmarks.py for methodology and the baseline
+assumption.
+"""
+
+import sys
+
+from kme_tpu.benchmarks import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""Benchmark suite (BASELINE.md matrix).
+
+The reference publishes no numbers (BASELINE.md); its structural bound is
+single-digit-thousands of orders/sec (serial awaited produce per order,
+commit per record, JSON serde, RocksDB round-trips — BASELINE.md table).
+`REFERENCE_BASELINE_OPS` pins the top of that band (5k orders/sec) as the
+denominator for `vs_baseline`, documented here so the ratio is honest and
+reproducible.
+
+The headline metric is matched orders/sec through the device engine on
+the reference harness distribution (exchange_test.js), measured
+steady-state (post-compile) on whatever backend is active — the real TPU
+under the driver, host CPU elsewhere.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+REFERENCE_BASELINE_OPS = 5_000.0  # orders/sec, derived bound (BASELINE.md)
+
+
+def bench_parity_engine(events: int = 4096, seed: int = 0, batch: int = 256,
+                        compat: str = "java") -> dict:
+    """Throughput of the serial device parity engine on the stock harness
+    workload. Returns the bench record (one JSON-able dict)."""
+    from kme_tpu.engine.parity import ParityCaps, ParityEngine
+    from kme_tpu.workload import harness_stream
+
+    caps = ParityCaps(balances=32, positions=8192, books=32, buckets=1024,
+                      orders=16384, max_events=64, batch=batch)
+    msgs = harness_stream(events, seed=seed)
+    eng = ParityEngine(compat, caps)
+    # warmup: compile + first dispatch
+    eng.process_batch(msgs[:batch])
+    t0 = time.perf_counter()
+    eng.process_batch(msgs[batch:])
+    dt = time.perf_counter() - t0
+    n = len(msgs) - batch
+    ops = n / dt
+    import jax
+    return {
+        "metric": "orders_per_sec_serial_parity",
+        "value": round(ops, 1),
+        "unit": "orders/s",
+        "vs_baseline": round(ops / REFERENCE_BASELINE_OPS, 3),
+        "detail": {
+            "events": n, "seconds": round(dt, 3), "batch": batch,
+            "compat": compat, "backend": jax.devices()[0].platform,
+            "baseline_assumption_ops": REFERENCE_BASELINE_OPS,
+        },
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="kme-bench")
+    p.add_argument("--events", type=int, default=4096)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--compat", choices=("java", "fixed"), default="java")
+    args = p.parse_args(argv)
+    rec = bench_parity_engine(args.events, args.seed, args.batch, args.compat)
+    out = {k: rec[k] for k in ("metric", "value", "unit", "vs_baseline")}
+    print(json.dumps(out))
+    print(json.dumps(rec["detail"]), file=sys.stderr)
+    return 0

@@ -570,14 +570,13 @@ def _try_match(st, err, msg, outbuf, taker_size, java, max_events):
         "st": st, "err": err, "outbuf": outbuf, "tsize": taker_size,
         "maker": maker, "maker_ptr": bfirst, "bkey": bkey, "blast": blast,
         "msb": msb, "lsb": lsb, "done": empty | (err != ERR_OK),
-        "writeback": jnp.asarray(False),
+        # When the book is non-empty the loop runs; a guard-false first
+        # iteration still does the post-loop writeback (KProcessor.java:259-261).
+        "writeback": ~empty & (err == ERR_OK),
         "taker_oid": msg["oid"].astype(_I64), "taker_aid": msg["aid"].astype(_I64),
         "taker_sid": msg["sid"].astype(_I64),
         "taker_price": msg["price"].astype(_I32),
     }
-    # When the book is non-empty the loop runs; a guard-false first
-    # iteration still performs the post-loop writeback (KProcessor.java:259-261).
-    carry["writeback"] = ~empty & (err == ERR_OK)
     c = jax.lax.while_loop(cond, body, carry)
 
     st, err, outbuf = c["st"], c["err"], c["outbuf"]
@@ -788,10 +787,14 @@ def _wipe_book_fixed(st, err, book_key, java, max_iters):
         out["err"] = _guard(out["err"], out["iters"] >= max_iters, ERR_CRASH)
         return out
 
+    # carry constants derived from traced inputs so the loop types stay
+    # consistent under shard_map's varying-axis tracking
+    zi64 = book_key.astype(_I64) * 0
+    zi32 = zi64.astype(_I32)
     carry = {"st": st, "err": err, "msb": msb, "lsb": lsb,
-             "walking": jnp.asarray(False), "ptr": jnp.asarray(0, _I64),
-             "price": jnp.asarray(-1, _I32), "done": ~found,
-             "iters": jnp.asarray(0, _I32)}
+             "walking": zi32 != 0, "ptr": zi64,
+             "price": zi32 - 1, "done": ~found,
+             "iters": zi32}
     c = jax.lax.while_loop(cond, body, carry)
     st, err = c["st"], c["err"]
     st2, err2 = _book_put(st, err, book_key, c["msb"], c["lsb"])
@@ -876,11 +879,11 @@ def _dense_op(action, pad):
 
 
 @functools.lru_cache(maxsize=None)
-def build_step(caps: ParityCaps, compat: str):
-    """Build the jitted batch step: (state, msgs) -> (state, outputs).
+def build_step_fn(caps: ParityCaps, compat: str):
+    """Build the PURE batch step: (state, msgs) -> (state, outputs).
 
-    Cached per (caps, compat) so every ParityEngine with the same shape
-    shares one compiled XLA program.
+    Jit-free so it can be embedded in shard_map/vmap contexts; use
+    build_step() for the compiled host-callable with buffer donation.
 
     msgs: dict of (T,)-arrays. outputs: dict of per-message results
     (result, action_out, size_out, prev_out, prev_has_out, events,
@@ -890,11 +893,14 @@ def build_step(caps: ParityCaps, compat: str):
     max_iters = caps.orders + 130
 
     def one_message(st, err, msg):
-        outbuf = (jnp.zeros((E, 6), _I64), jnp.asarray(0, _I32))
+        # buffers derived from the traced message so shard_map's
+        # varying-axis types stay consistent through loops/branches
+        zv32 = (msg["action"] * 0).astype(_I32)
+        outbuf = (jnp.zeros((E, 6), _I64) + zv32.astype(_I64), zv32)
 
         def b_pad(a):
             st, err, msg, outbuf = a
-            return st, err, jnp.asarray(True), _echo_of(msg), outbuf
+            return st, err, msg["pad"] | True, _echo_of(msg), outbuf
 
         def b_add_symbol(a):
             return _h_add_symbol(*a, java)
@@ -921,11 +927,11 @@ def build_step(caps: ParityCaps, compat: str):
             st, err, r, echo, outbuf = _h_payout(st, err, msg, outbuf, java,
                                                  max_iters)
             # Q5/Q6: java discards payout's result (KProcessor.java:113-115)
-            return st, err, (jnp.asarray(False) if java else r), echo, outbuf
+            return st, err, ((r & False) if java else r), echo, outbuf
 
         def b_unknown(a):
             st, err, msg, outbuf = a
-            return st, err, jnp.asarray(False), _echo_of(msg), outbuf
+            return st, err, msg["pad"] & False, _echo_of(msg), outbuf
 
         branches = [b_pad, b_add_symbol, b_remove_symbol, b_trade, b_cancel,
                     b_create_balance, b_transfer, b_payout, b_unknown]
@@ -958,13 +964,20 @@ def build_step(caps: ParityCaps, compat: str):
         out["err"] = err
         return (st, err), out
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state, msgs):
-        (state, err), outs = jax.lax.scan(
-            scan_body, (state, jnp.asarray(ERR_OK, _I32)), msgs)
+        err0 = (state["bal_val"][0] * 0).astype(_I32) + ERR_OK
+        (state, err), outs = jax.lax.scan(scan_body, (state, err0), msgs)
         return state, outs
 
     return step
+
+
+@functools.lru_cache(maxsize=None)
+def build_step(caps: ParityCaps, compat: str):
+    """Compiled batch step with state-buffer donation; cached per
+    (caps, compat) so every ParityEngine with the same shape shares one
+    XLA program."""
+    return jax.jit(build_step_fn(caps, compat), donate_argnums=(0,))
 
 
 # ---------------------------------------------------------------------------

@@ -14,6 +14,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The axon terminal's sitecustomize registers the tunneled TPU and sets
+# jax_platforms="axon,cpu" programmatically, which overrides the env var.
+# Re-assert CPU before any backend initialization so tests run on the
+# 8-device virtual host platform, not through the TPU tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
