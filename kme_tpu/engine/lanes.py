@@ -1,0 +1,506 @@
+"""Throughput engine: vmapped per-symbol order-book lanes.
+
+This is the TPU-first redesign of the matching core (SURVEY.md §7 design
+stance): the reference's KV-stores + intrusive linked lists
+(KProcessor.java:30-49, 448-475) dissolve into dense per-lane arrays, and
+the per-message match loop (KProcessor.java:237-258) becomes a
+sort + prefix-sum *sweep* — no data-dependent loop, constant work per
+step, everything vectorized over S symbol lanes.
+
+Semantics: compat='fixed' exactly (the corrected reference semantics the
+scalar oracle defines — kme_tpu/oracle/engine.py docstring). Java-quirk
+parity is the serial parity engine's job; this engine is the performance
+path. The one observable java-era behavior kept is the Q9 prev-echo leak
+(appending to a non-empty price bucket stamps the bucket tail's oid into
+the echoed order), which `compat=fixed` preserves.
+
+Exact-parallelism model (SURVEY.md §7 H1). A key structural fact of the
+reference: maker fills carry price 0 (KProcessor.java:268-271), so
+`fillOrder` credits `size * 0 == 0` to maker balances — balances are
+mutated ONLY by their own account's messages (margin reserve/release,
+taker credit, transfers) plus the rare PAYOUT. Therefore a parallel step
+that (a) keeps per-symbol arrival order within its lane, (b) never
+schedules two messages from the same account, and (c) isolates
+PAYOUT/REMOVE_SYMBOL as barrier steps, is *bit-exact* with serial replay.
+The host sequencer (kme_tpu/runtime/sequencer.py) enforces (a)-(c).
+
+Data layout per lane (S = lanes, N = slots/side, A = dense accounts):
+- book slots (S, 2, N): oid i64, aid-index i32, price i32, size i32,
+  seqno i32 (FIFO arrival stamp), used bool. Price-time priority is the
+  scalar key `price * 2^32 + seqno` (ask side; bid side uses 125-price),
+  so "best maker" is one masked argsort — the bitmap+bucket+linked-list
+  machinery (KProcessor.java:359-416) has no equivalent here.
+- positions (S, A): amount i64, available i64, used bool — dense by
+  (lane, account), so maker-position scatter needs no associative probe.
+- balances (A,) + used (A,): replicated across shards; per-step deltas
+  are scattered densely and (under shard_map) psum-merged — disjointness
+  is guaranteed by the scheduler, so the merge is exact.
+
+Fills are emitted as compact per-step arrays (maker oid/aid/price + fill
+size, in priority order); the host reconstructs the byte-exact
+IN/fill/OUT record stream (maker event before taker event per trade,
+KProcessor.java:265-274).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import kme_tpu._jaxsetup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from kme_tpu import opcodes as op
+
+_I64 = jnp.int64
+_I32 = jnp.int32
+
+# dense lane op codes (host-side sequencer packs these)
+L_NOP = 0
+L_BUY = 1
+L_SELL = 2
+L_CANCEL = 3
+L_CREATE = 4
+L_TRANSFER = 5
+L_ADD_SYMBOL = 6
+
+# lane error codes (sticky, per batch)
+LERR_OK = 0
+LERR_BOOK_FULL = 1    # resting-slot capacity exhausted (H2 envelope)
+LERR_FILLS_FULL = 2   # sweep crossed more than max_fills makers (H3)
+
+
+@dataclasses.dataclass(frozen=True)
+class LaneConfig:
+    """Static shapes; one XLA program per distinct value."""
+
+    lanes: int = 8            # S — symbols (sharded axis)
+    slots: int = 128          # N — resting orders per book side
+    accounts: int = 256       # A — dense account capacity
+    max_fills: int = 16       # E — makers swept per taker (H3 bound)
+    steps: int = 64           # T — scan steps per dispatch
+
+
+def make_lane_state(cfg: LaneConfig):
+    S, N, A = cfg.lanes, cfg.slots, cfg.accounts
+    return {
+        "slot_oid": jnp.zeros((S, 2, N), _I64),
+        "slot_aid": jnp.zeros((S, 2, N), _I32),
+        "slot_price": jnp.zeros((S, 2, N), _I32),
+        "slot_size": jnp.zeros((S, 2, N), _I32),
+        "slot_seq": jnp.zeros((S, 2, N), _I32),
+        "slot_used": jnp.zeros((S, 2, N), bool),
+        "seq": jnp.zeros((S,), _I32),
+        "book_exists": jnp.zeros((S,), bool),
+        "pos_amt": jnp.zeros((S, A), _I64),
+        "pos_avail": jnp.zeros((S, A), _I64),
+        "pos_used": jnp.zeros((S, A), bool),
+        "bal": jnp.zeros((A,), _I64),
+        "bal_used": jnp.zeros((A,), bool),
+        "err": jnp.zeros((), _I32),
+    }
+
+
+def _priority_key(side, price, seqno):
+    """Scalar price-time key, ascending = better maker. side is the
+    MAKER side: 1 (asks) -> low price first; 0 (bids) -> high first."""
+    p = jnp.where(side == 1, price, 125 - price).astype(_I64)
+    return (p << 32) | seqno.astype(_I64)
+
+
+@functools.lru_cache(maxsize=None)
+def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
+    """The pure scan-step batch function: (state, batch) -> (state, outs).
+
+    batch: dict of (T, S) arrays (act, oid, aid, price, size).
+    outs per (t, lane): ok, residual, append prev info, fill arrays,
+    plus the sticky error code.
+    When axis_name is set the balance-delta merge is psum'd over that
+    mesh axis (shard_map embedding)."""
+    S, N, A, E = cfg.lanes, cfg.slots, cfg.accounts, cfg.max_fills
+    lane_ids = jnp.arange(S, dtype=_I32)
+
+    def one_step(st, msg):
+        act, oid, aid = msg["act"], msg["oid"], msg["aid"]
+        price, size = msg["price"], msg["size"]
+
+        is_trade = (act == L_BUY) | (act == L_SELL)
+        is_buy = act == L_BUY
+        side = jnp.where(is_buy, 0, 1).astype(_I32)     # own (rest) side
+        opp = (1 - side).astype(_I32)
+
+        bal_g = st["bal"][aid]              # (S,) pre-step actor balances
+        bal_ok = st["bal_used"][aid]
+
+        # ------------------------------------------------- CREATE_BALANCE
+        create_ok = (act == L_CREATE) & ~bal_ok
+
+        # ------------------------------------------------------- TRANSFER
+        size64 = size.astype(_I64)
+        transfer_ok = (act == L_TRANSFER) & bal_ok & ~(bal_g < -size64)
+
+        # ----------------------------------------------------- ADD_SYMBOL
+        addsym_ok = (act == L_ADD_SYMBOL) & ~st["book_exists"]
+        book_exists = st["book_exists"] | addsym_ok
+
+        # ------------------------------------------------- TRADE: margin
+        # checkBalance (KProcessor.java:167-182), fixed-domain: price in
+        # [0,126), size > 0 (validated), so no int32 wrap can occur.
+        valid = (price >= 0) & (price < 126) & (size > 0)
+        signed = jnp.where(is_buy, size, -size).astype(_I32)
+        signed64 = signed.astype(_I64)
+        p_amt = st["pos_amt"][lane_ids, aid]
+        p_avail = jnp.where(st["pos_used"][lane_ids, aid],
+                            st["pos_avail"][lane_ids, aid], 0)
+        adj = jnp.where(is_buy,
+                        jnp.maximum(jnp.minimum(p_avail, 0), -signed64),
+                        jnp.minimum(jnp.maximum(p_avail, 0), -signed64))
+        unit = jnp.where(is_buy, price, price - 100).astype(_I64)
+        risk = (signed64 + adj) * unit
+        trade_ok = is_trade & valid & st["book_exists"] & bal_ok & ~(bal_g < risk)
+        # margin netting blocks part of the opposite position (:179)
+        adj_write = trade_ok & (adj != 0)
+        pos_avail = st["pos_avail"].at[lane_ids, aid].add(
+            jnp.where(adj_write, -adj, 0))
+
+        # -------------------------------------------------- TRADE: sweep
+        # the match loop (KProcessor.java:237-258) as one masked argsort +
+        # prefix sum over the opposite side's slots
+        g = lambda a: a[lane_ids, opp]                 # (S, N) opp side
+        m_used = g(st["slot_used"])
+        m_price, m_size = g(st["slot_price"]), g(st["slot_size"])
+        m_oid, m_aid, m_seq = g(st["slot_oid"]), g(st["slot_aid"]), g(st["slot_seq"])
+        crossing = m_used & jnp.where(
+            is_buy[:, None], m_price <= price[:, None], m_price >= price[:, None])
+        crossing = crossing & trade_ok[:, None]
+        key = _priority_key(opp[:, None], m_price, m_seq)
+        BIG = jnp.asarray((1 << 62), _I64)
+        masked_key = jnp.where(crossing, key, BIG)
+        order = jnp.argsort(masked_key, axis=1)        # (S, N) best-first
+        take = lambda a: jnp.take_along_axis(a, order, axis=1)
+        sz_sorted = jnp.where(take(crossing), take(m_size), 0)
+        prefix = jnp.cumsum(sz_sorted, axis=1) - sz_sorted   # exclusive
+        z = jnp.where(trade_ok, size, 0)[:, None]
+        fill_sorted = jnp.clip(z - prefix, 0, sz_sorted)
+        filled_total = jnp.sum(fill_sorted, axis=1).astype(_I32)
+        residual = (size - jnp.where(trade_ok, filled_total, 0)).astype(_I32)
+        nfill = jnp.sum(fill_sorted > 0, axis=1).astype(_I32)
+        overflow_fills = nfill > E
+
+        # write back maker sizes via the inverse permutation
+        inv = jnp.argsort(order, axis=1)
+        fill_slot = jnp.take_along_axis(fill_sorted, inv, axis=1)
+        new_m_size = (m_size - fill_slot).astype(_I32)
+        new_m_used = m_used & (new_m_size > 0)
+        slot_size = st["slot_size"].at[lane_ids, opp].set(
+            jnp.where(trade_ok[:, None], new_m_size, m_size))
+        slot_used = st["slot_used"].at[lane_ids, opp].set(
+            jnp.where(trade_ok[:, None], new_m_used, m_used))
+
+        # compact per-trade outputs (priority order), truncated at E
+        fo_oid = take(m_oid)[:, :E]
+        fo_aid = take(m_aid)[:, :E]
+        fo_price = take(m_price)[:, :E]
+        fo_fill = fill_sorted[:, :E].astype(_I32)
+
+        # ---------------------------------- TRADE: position updates
+        # Exact closed-form replay of the per-trade fill sequence (maker
+        # fill then taker fill per trade, KProcessor.java:272-273),
+        # including delete-at-zero/recreate semantics. Key identity:
+        # create(s) == update from (0,0), and a delete only ever happens
+        # when the running amount IS zero — so the running amount is the
+        # plain prefix sum, and `available` restarts from zero after the
+        # account's LAST zero-crossing within the sweep:
+        #   amt_final  = amt0 + sum(fills)
+        #   avail_fin  = sum(fills after last zero prefix)   if any zero
+        #              = avail0 + sum(fills)                 otherwise
+        # This replaces a 2E-deep sequential loop with a few (S,2E,2E)
+        # einsums — pure VPU/MXU work, no serialization.
+        twoE = 2 * E
+        idx2 = jnp.arange(twoE, dtype=_I32)
+        li = lane_ids[:, None]
+        acc = jnp.zeros((S, twoE), _I32)
+        acc = acc.at[:, 0::2].set(fo_aid).at[:, 1::2].set(
+            jnp.broadcast_to(aid[:, None], (S, E)))
+        m_sgn = jnp.where(is_buy[:, None], -fo_fill, fo_fill).astype(_I64)
+        t_sgn = jnp.where(is_buy[:, None], fo_fill, -fo_fill).astype(_I64)
+        sgn = jnp.zeros((S, twoE), _I64).at[:, 0::2].set(m_sgn)
+        sgn = sgn.at[:, 1::2].set(t_sgn)
+        fv = (fo_fill > 0) & trade_ok[:, None]
+        fvalid = jnp.zeros((S, twoE), bool).at[:, 0::2].set(fv)
+        fvalid = fvalid.at[:, 1::2].set(fv)
+        a0 = jnp.where(st["pos_used"][li, acc], st["pos_amt"][li, acc], 0)
+        v0 = jnp.where(st["pos_used"][li, acc], pos_avail[li, acc], 0)
+        eq = ((acc[:, :, None] == acc[:, None, :])
+              & fvalid[:, :, None] & fvalid[:, None, :])     # (S, i, j)
+        le = idx2[:, None] <= idx2[None, :]
+        prefix = a0 + jnp.einsum("sij,si->sj", (eq & le[None]).astype(_I64), sgn)
+        zero = fvalid & (prefix == 0)
+        # per entry j: index of its account's last zero prefix (-1 if none)
+        jlast = jnp.max(
+            jnp.where(zero[:, :, None] & eq, idx2[None, :, None], -1), axis=1)
+        after = eq & (idx2[None, :, None] > jlast[:, None, :])
+        avail_sum = jnp.einsum("sij,si->sj", after.astype(_I64), sgn)
+        total = jnp.einsum("sij,si->sj", eq.astype(_I64), sgn)
+        anyzero = jnp.any(zero[:, :, None] & eq, axis=1)
+        amt_fin = a0 + total
+        avail_fin = jnp.where(anyzero, avail_sum, v0 + total)
+        used_fin = amt_fin != 0
+        # scatter with a dummy column for invalid entries; duplicate
+        # indices carry identical values, so the scatter is deterministic
+        acc_t = jnp.where(fvalid, acc, A)
+
+        def _scat(arr, vals):
+            pad = jnp.concatenate(
+                [arr, jnp.zeros((S, 1), arr.dtype)], axis=1)
+            pad = pad.at[li, acc_t].set(vals.astype(arr.dtype))
+            return pad[:, :A]
+
+        pos_amt = _scat(st["pos_amt"], jnp.where(used_fin, amt_fin, 0))
+        pos_avail = _scat(pos_avail, jnp.where(used_fin, avail_fin, 0))
+        pos_used = _scat(st["pos_used"], used_fin)
+
+        # taker balance credit: sum of fill * improvement (maker credit is
+        # size * 0 == 0 — the structural fact the scheduler relies on)
+        improve = (jnp.where(trade_ok[:, None], price[:, None], 0)
+                   - fo_price).astype(_I64)
+        signed_credit = jnp.where(is_buy[:, None], fo_fill, -fo_fill).astype(_I64)
+        credit = jnp.sum(signed_credit * improve, axis=1)
+
+        # ------------------------------------------------- TRADE: rest
+        rest = trade_ok & (residual > 0)
+        own = lambda a: a[lane_ids, side]
+        o_used = own(slot_used)  # after maker updates (opp side untouched)
+        free_idx = jnp.argmax(~o_used, axis=1).astype(_I32)
+        have_free = jnp.any(~o_used, axis=1)
+        overflow_book = rest & ~have_free
+        # Q9 prev-echo: tail of my price bucket = max seqno among used
+        # same-price slots on my side
+        o_price, o_seq_ = own(st["slot_price"]), own(st["slot_seq"])
+        o_oid_arr, o_used0 = own(st["slot_oid"]), own(st["slot_used"])
+        same_level = o_used0 & (o_price == price[:, None])
+        bucket_nonempty = jnp.any(same_level, axis=1)
+        tail_idx = jnp.argmax(
+            jnp.where(same_level, o_seq_, -1), axis=1).astype(_I32)
+        tail_oid = o_oid_arr[lane_ids, tail_idx]
+
+        do_rest = rest & have_free
+        seqno = st["seq"]
+        sidx = (lane_ids, side, free_idx)
+        slot_oid = st["slot_oid"].at[sidx].set(
+            jnp.where(do_rest, oid, st["slot_oid"][sidx]))
+        slot_aid = st["slot_aid"].at[sidx].set(
+            jnp.where(do_rest, aid, st["slot_aid"][sidx]))
+        slot_price = st["slot_price"].at[sidx].set(
+            jnp.where(do_rest, price, st["slot_price"][sidx]))
+        slot_size = slot_size.at[sidx].set(
+            jnp.where(do_rest, residual, slot_size[sidx]))
+        slot_seq = st["slot_seq"].at[sidx].set(
+            jnp.where(do_rest, seqno, st["slot_seq"][sidx]))
+        slot_used = slot_used.at[sidx].set(slot_used[sidx] | do_rest)
+        seq = seqno + do_rest.astype(_I32)
+
+        # --------------------------------------------------------- CANCEL
+        # removeOrder (KProcessor.java:289-323): slot lookup by oid +
+        # ownership, then margin release (postRemoveAdjustments :325-333)
+        is_cancel = act == L_CANCEL
+        hit = st["slot_used"] & (st["slot_oid"] == oid[:, None, None])
+        hit_flat = hit.reshape(S, 2 * N)
+        hit_any = jnp.any(hit_flat, axis=1)
+        hit_idx = jnp.argmax(hit_flat, axis=1).astype(_I32)
+        h_side = hit_idx // N
+        h_slot = hit_idx % N
+        c_aid = st["slot_aid"][lane_ids, h_side, h_slot]
+        c_price = st["slot_price"][lane_ids, h_side, h_slot]
+        c_size = st["slot_size"][lane_ids, h_side, h_slot]
+        cancel_ok = is_cancel & hit_any & (c_aid == aid)
+        cidx = (lane_ids, h_side, h_slot)
+        slot_used = slot_used.at[cidx].set(
+            slot_used[cidx] & ~cancel_ok)
+        # margin release
+        c_isbuy = h_side == 0
+        c_signed = jnp.where(c_isbuy, c_size, -c_size).astype(_I64)
+        cp_amt = pos_amt[lane_ids, aid]
+        cp_avail = jnp.where(pos_used[lane_ids, aid],
+                             pos_avail[lane_ids, aid], 0)
+        blocked = jnp.where(pos_used[lane_ids, aid], cp_amt - cp_avail, 0)
+        c_adj = jnp.where(c_isbuy,
+                          jnp.maximum(jnp.minimum(blocked, 0), -c_signed),
+                          jnp.minimum(jnp.maximum(blocked, 0), -c_signed))
+        c_unit = jnp.where(c_isbuy, c_price, c_price - 100).astype(_I64)
+        c_release = (c_signed + c_adj) * c_unit
+        c_adj_write = cancel_ok & (c_adj != 0)
+        pos_avail = pos_avail.at[lane_ids, aid].add(
+            jnp.where(c_adj_write, c_adj, 0))
+
+        # ------------------------------------------- balance delta merge
+        delta = (jnp.where(transfer_ok, size64, 0)
+                 + jnp.where(trade_ok, -risk + credit, 0)
+                 + jnp.where(cancel_ok, c_release, 0))
+        dense_delta = jnp.zeros((A,), _I64).at[aid].add(delta)
+        dense_create = jnp.zeros((A,), bool).at[aid].max(create_ok)
+        if axis_name is not None:
+            dense_delta = jax.lax.psum(dense_delta, axis_name)
+            dense_create = jax.lax.psum(
+                dense_create.astype(_I32), axis_name) > 0
+        bal = st["bal"] + dense_delta
+        bal_used = st["bal_used"] | dense_create
+
+        err = st["err"]
+        err = jnp.where((err == LERR_OK) & jnp.any(overflow_book),
+                        jnp.asarray(LERR_BOOK_FULL, _I32), err)
+        err = jnp.where((err == LERR_OK) & jnp.any(overflow_fills & trade_ok),
+                        jnp.asarray(LERR_FILLS_FULL, _I32), err)
+        if axis_name is not None:
+            # any shard's envelope error becomes globally visible (and the
+            # replicated err stays identical across shards)
+            err = jax.lax.pmax(err, axis_name)
+
+        ok = jnp.where(
+            is_trade, trade_ok,
+            jnp.where(is_cancel, cancel_ok,
+                      jnp.where(act == L_CREATE, create_ok,
+                                jnp.where(act == L_TRANSFER, transfer_ok,
+                                          jnp.where(act == L_ADD_SYMBOL,
+                                                    addsym_ok, act == L_NOP)))))
+
+        new_st = {
+            "slot_oid": slot_oid, "slot_aid": slot_aid,
+            "slot_price": slot_price, "slot_size": slot_size,
+            "slot_seq": slot_seq, "slot_used": slot_used,
+            "seq": seq, "book_exists": book_exists,
+            "pos_amt": pos_amt, "pos_avail": pos_avail, "pos_used": pos_used,
+            "bal": bal, "bal_used": bal_used, "err": err,
+        }
+        outs = {
+            "ok": ok,
+            "residual": jnp.where(trade_ok, residual, size).astype(_I32),
+            "append": bucket_nonempty & do_rest,
+            "prev_oid": tail_oid,
+            "nfill": jnp.where(trade_ok, jnp.minimum(nfill, E), 0),
+            "fill_oid": fo_oid, "fill_aid": fo_aid,
+            "fill_price": fo_price, "fill_size": fo_fill,
+            "err": err,
+        }
+        return new_st, outs
+
+    def step(state, batch):
+        return jax.lax.scan(one_step, state, batch)
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# barrier ops (rare; invoked by the host between scan dispatches)
+
+@functools.lru_cache(maxsize=None)
+def build_barrier_ops(cfg: LaneConfig, axis_name: Optional[str] = None):
+    """payout/remove_symbol as standalone jitted-able fns over ONE lane.
+
+    Both wipe the lane's book with per-order margin release in the
+    reference's wipe order — min price level first, FIFO within level,
+    buy side then sell side (oracle._wipe_book_fixed) — which is
+    sequential per account (each release changes `available`, feeding the
+    next release's netting), hence the fori_loop over slots in wipe
+    order. PAYOUT then credits `amount * size` per holder (YES) or just
+    deletes positions (NO) — exchange_test.js:76-79 intent, oracle
+    `_payout` fixed mode."""
+    S, N, A = cfg.lanes, cfg.slots, cfg.accounts
+    lane_ids = jnp.arange(S, dtype=_I32)
+
+    def wipe_lane(st, lane, do):
+        """Release margin for every resting order of `lane`, clear slots.
+        `do` gates the whole operation."""
+        sl = lambda k: st[k][lane]                      # (2, N)
+        used = sl("slot_used")
+        price = sl("slot_price")
+        seqno = sl("slot_seq")
+        # wipe order: side-major (buy side first), then (price, seqno) —
+        # the reference's wipe sequence (oracle._wipe_book_fixed). The
+        # side tag (1<<44) dominates the (price<<32 | seq) key range.
+        key = (jnp.repeat(jnp.arange(2, dtype=_I64)[:, None] * (1 << 44), N, 1)
+               + (price.astype(_I64) << 32) + seqno.astype(_I64))
+        key = jnp.where(used, key, jnp.asarray(1 << 62, _I64))
+        order = jnp.argsort(key.reshape(2 * N))
+        n_used = jnp.sum(used)
+
+        def body(i, carry):
+            pos_amt, pos_avail, pos_used, bal_delta = carry
+            flat = order[i]
+            s_side = flat // N
+            s_slot = flat % N
+            active = do & (i < n_used)
+            a = st["slot_aid"][lane, s_side, s_slot]
+            pr = st["slot_price"][lane, s_side, s_slot]
+            sz = st["slot_size"][lane, s_side, s_slot]
+            isbuy = s_side == 0
+            signed = jnp.where(isbuy, sz, -sz).astype(_I64)
+            amt = pos_amt[a]
+            avail = jnp.where(pos_used[a], pos_avail[a], 0)
+            blocked = jnp.where(pos_used[a], amt - avail, 0)
+            adj = jnp.where(isbuy,
+                            jnp.maximum(jnp.minimum(blocked, 0), -signed),
+                            jnp.minimum(jnp.maximum(blocked, 0), -signed))
+            unit = jnp.where(isbuy, pr, pr - 100).astype(_I64)
+            release = (signed + adj) * unit
+            pos_avail = pos_avail.at[a].add(jnp.where(active & (adj != 0), adj, 0))
+            bal_delta = bal_delta.at[a].add(jnp.where(active, release, 0))
+            return pos_amt, pos_avail, pos_used, bal_delta
+
+        # zero delta derived from lane-sharded state so its varying-axis
+        # type matches the loop body's output under shard_map
+        zv64 = (st["seq"][0] * 0).astype(_I64)
+        carry = (st["pos_amt"][lane], st["pos_avail"][lane],
+                 st["pos_used"][lane], jnp.zeros((A,), _I64) + zv64)
+        pos_amt_l, pos_avail_l, pos_used_l, bal_delta = jax.lax.fori_loop(
+            0, 2 * N, body, carry)
+        return pos_amt_l, pos_avail_l, pos_used_l, bal_delta
+
+    def settle(state, lane, credit_size, mode):
+        """mode: 0 = REMOVE_SYMBOL, 1 = PAYOUT YES, 2 = PAYOUT NO.
+
+        Returns (state, ok). Under shard_map, `lane` is the LOCAL lane
+        index on the owning shard; other shards call with do=False via
+        lane=-1."""
+        do = (lane >= 0) & state["book_exists"][jnp.maximum(lane, 0)]
+        lane_c = jnp.maximum(lane, 0)
+        pos_amt_l, pos_avail_l, pos_used_l, bal_delta = wipe_lane(
+            state, lane_c, do)
+        st = dict(state)
+        st["pos_amt"] = st["pos_amt"].at[lane_c].set(
+            jnp.where(do, pos_amt_l, st["pos_amt"][lane_c]))
+        st["pos_avail"] = st["pos_avail"].at[lane_c].set(
+            jnp.where(do, pos_avail_l, st["pos_avail"][lane_c]))
+        st["slot_used"] = st["slot_used"].at[lane_c].set(
+            jnp.where(do, False, st["slot_used"][lane_c]))
+        st["book_exists"] = st["book_exists"].at[lane_c].set(
+            jnp.where(do, False, st["book_exists"][lane_c]))
+
+        # payout credit/delete over the lane's positions
+        is_payout = mode > 0
+        credit = (mode == 1)
+        pm = jnp.where(do & is_payout, True, False)
+        holders = st["pos_used"][lane_c]
+        amts = st["pos_amt"][lane_c]
+        pay = jnp.where(pm & credit & holders,
+                        amts * credit_size.astype(_I64), 0)
+        bal_delta = bal_delta + pay
+        clear = pm & holders
+        st["pos_used"] = st["pos_used"].at[lane_c].set(
+            jnp.where(clear, False, st["pos_used"][lane_c]))
+        st["pos_amt"] = st["pos_amt"].at[lane_c].set(
+            jnp.where(clear, 0, st["pos_amt"][lane_c]))
+        st["pos_avail"] = st["pos_avail"].at[lane_c].set(
+            jnp.where(clear, 0, st["pos_avail"][lane_c]))
+
+        if axis_name is not None:
+            bal_delta = jax.lax.psum(bal_delta, axis_name)
+            do_any = jax.lax.psum(do.astype(_I32), axis_name) > 0
+        else:
+            do_any = do
+        st["bal"] = st["bal"] + bal_delta
+        return st, do_any
+
+    return settle

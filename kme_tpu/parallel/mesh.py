@@ -1,0 +1,114 @@
+"""Sharded engine step: shard_map over the 'symbol' mesh axis.
+
+Layout (SURVEY.md §2.3 "TPU-native equivalent", §7 step 6):
+- lane state (books, positions, seq, flags): sharded on the leading
+  symbol axis — each device owns S/n contiguous lanes;
+- account state (balances): replicated; every step produces a dense
+  (A,) delta on each shard which is psum-merged — exact because the
+  scheduler guarantees per-step account disjointness (lanes.py
+  docstring), so the sum has at most one non-zero contributor per slot;
+- the sticky error code: pmax-merged so any shard's envelope error
+  surfaces globally;
+- barrier ops (payout/remove): the owning shard resolves the global
+  lane to its local index, wipes/credits locally, and the balance
+  delta rides the same psum.
+
+The same step function works single-device (axis_name=None) — the
+sharded build is a thin shard_map wrapper around engine/lanes.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import kme_tpu._jaxsetup  # noqa: F401
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kme_tpu.engine import lanes as L
+
+AXIS = "symbol"
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:  # pragma: no cover - older jax fallback
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def build_mesh(shards: int) -> Mesh:
+    devs = jax.devices()
+    if len(devs) < shards:
+        raise ValueError(
+            f"need {shards} devices for {shards} shards, have {len(devs)}")
+    return Mesh(np.array(devs[:shards]), axis_names=(AXIS,))
+
+
+def state_specs(state) -> dict:
+    """PartitionSpec pytree for the lane state: lane-major arrays sharded
+    on the symbol axis, account/global arrays replicated."""
+    specs = {}
+    for k, v in state.items():
+        if k in ("bal", "bal_used", "err"):
+            specs[k] = P()
+        else:
+            specs[k] = P(AXIS)
+    return specs
+
+
+def build_sharded_step(cfg: L.LaneConfig, mesh: Mesh):
+    """(state, batch) -> (state, outs), lanes sharded over `mesh`."""
+    assert cfg.lanes % mesh.devices.size == 0, (cfg.lanes, mesh.devices.size)
+    local_cfg = L.LaneConfig(
+        lanes=cfg.lanes // mesh.devices.size, slots=cfg.slots,
+        accounts=cfg.accounts, max_fills=cfg.max_fills, steps=cfg.steps)
+    inner = L.build_lane_step(local_cfg, axis_name=AXIS)
+
+    st_specs = state_specs(L.make_lane_state(cfg))
+    batch_specs = {k: P(None, AXIS) for k in ("act", "oid", "aid", "price",
+                                              "size")}
+    out_specs = {
+        "ok": P(None, AXIS), "residual": P(None, AXIS),
+        "append": P(None, AXIS), "prev_oid": P(None, AXIS),
+        "nfill": P(None, AXIS), "fill_oid": P(None, AXIS),
+        "fill_aid": P(None, AXIS), "fill_price": P(None, AXIS),
+        "fill_size": P(None, AXIS), "err": P(),
+    }
+    return _shard_map(inner, mesh, (st_specs, batch_specs),
+                      (st_specs, out_specs))
+
+
+def build_sharded_settle(cfg: L.LaneConfig, mesh: Mesh):
+    """(state, global_lane, credit_size, mode) -> (state, ok), sharded.
+
+    The owning shard computes its local lane index; other shards pass
+    lane=-1 (no-op) and contribute zero to the psum'd balance delta."""
+    n = mesh.devices.size
+    assert cfg.lanes % n == 0
+    S_local = cfg.lanes // n
+    local_cfg = L.LaneConfig(lanes=S_local, slots=cfg.slots,
+                             accounts=cfg.accounts, max_fills=cfg.max_fills,
+                             steps=cfg.steps)
+    inner = L.build_barrier_ops(local_cfg, axis_name=AXIS)
+
+    def settle(state, global_lane, credit_size, mode):
+        shard = jax.lax.axis_index(AXIS).astype(jnp.int32)
+        owner = global_lane // S_local == shard
+        local = jnp.where(owner, global_lane % S_local, -1).astype(jnp.int32)
+        return inner(state, local, credit_size, mode)
+
+    st_specs = state_specs(L.make_lane_state(cfg))
+    return _shard_map(settle, mesh, (st_specs, P(), P(), P()),
+                      (st_specs, P()))
+
+
+def shard_state(state, mesh: Mesh):
+    """Place a host-built state pytree onto the mesh with its specs."""
+    specs = state_specs(state)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs,
+        is_leaf=lambda x: not isinstance(x, dict))

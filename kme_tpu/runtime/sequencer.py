@@ -1,0 +1,238 @@
+"""Conflict-free scheduler: wire messages -> (step, lane) placements.
+
+The exactness contract (kme_tpu/engine/lanes.py docstring): a parallel
+step is bit-exact with serial replay iff
+  (a) each symbol's messages stay in arrival order in its lane,
+  (b) no two messages in a step share an actor account,
+  (c) PAYOUT / REMOVE_SYMBOL run as exclusive barrier steps.
+The greedy placement below enforces all three with two monotone clocks:
+`lane_next[lane]` (first free step of the lane) and `actor_next[aid]`
+(first step after the account's last message). Both only move forward,
+so per-symbol FIFO and per-account ordering hold by construction.
+
+The scheduler also owns the id spaces: raw aid -> dense account index
+(device arrays are dense — the reference's Long-keyed RocksDB maps,
+KProcessor.java:30-33, have no device equivalent), raw sid -> lane, and
+the oid -> sid routing map for cancels (the reference resolves cancels
+through the global Orders store, KProcessor.java:290; here the host
+routes them to the owning lane). Messages the device cannot act on
+(unknown-oid cancels, negative-sid ADD_SYMBOL, unmapped-symbol
+REMOVE/PAYOUT) are resolved host-side as synthesized rejects — state-free
+in the reference too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from kme_tpu import opcodes as op
+from kme_tpu.engine import lanes as L
+from kme_tpu.wire import OrderMsg
+
+
+class CapacityError(RuntimeError):
+    """The workload exceeds a static device capacity (symbols, accounts)."""
+
+
+class EnvelopeError(RuntimeError):
+    """A wire value falls outside the Jackson-parseable envelope (int32
+    price/size) — input on which the reference's deserializer throws and
+    its Streams thread dies (KProcessor.java:513-517)."""
+
+
+@dataclasses.dataclass
+class Placed:
+    """A device-executed message: its (segment, step, lane) coordinates."""
+    msg_index: int
+    segment: int
+    step: int       # step within segment
+    lane: int
+    lane_act: int   # L_* opcode
+    aid_idx: int
+    oid: int
+    price: int
+    size: int
+
+
+@dataclasses.dataclass
+class Barrier:
+    """A barrier-executed message (PAYOUT / REMOVE_SYMBOL)."""
+    msg_index: int
+    lane: int
+    mode: int       # 0 remove, 1 payout YES, 2 payout NO
+    credit_size: int
+
+
+@dataclasses.dataclass
+class HostReject:
+    """Resolved host-side: emit IN + OUT(REJECT) without device work."""
+    msg_index: int
+
+
+@dataclasses.dataclass
+class Schedule:
+    """segments[i] = number of steps in scan segment i; the executable
+    plan alternates scan segments and barriers in `program` order."""
+    placements: List[Placed]
+    barriers: List[Barrier]
+    host_rejects: List[HostReject]
+    segment_steps: List[int]
+    program: List[tuple]  # ("scan", seg_idx) | ("barrier", barrier_idx)
+
+
+_TRADE_ACTS = {op.BUY: L.L_BUY, op.SELL: L.L_SELL}
+
+
+class Scheduler:
+    def __init__(self, num_lanes: int, num_accounts: int) -> None:
+        self.S = num_lanes
+        self.A = num_accounts
+        self.aid_idx: Dict[int, int] = {}
+        self.sid_lane: Dict[int, int] = {}
+        self.oid_sid: Dict[int, int] = {}
+        self._rr_lane = 0  # round-robin for lane-free (account) ops
+
+    # -- id spaces ---------------------------------------------------------
+
+    def _acct(self, aid: int) -> int:
+        idx = self.aid_idx.get(aid)
+        if idx is None:
+            if len(self.aid_idx) >= self.A:
+                raise CapacityError(
+                    f"account capacity {self.A} exhausted (aid={aid})")
+            idx = len(self.aid_idx)
+            self.aid_idx[aid] = idx
+        return idx
+
+    def _lane(self, sid: int) -> int:
+        lane = self.sid_lane.get(sid)
+        if lane is None:
+            if len(self.sid_lane) >= self.S:
+                raise CapacityError(
+                    f"symbol capacity {self.S} exhausted (sid={sid})")
+            lane = len(self.sid_lane)
+            self.sid_lane[sid] = lane
+        return lane
+
+    def acct_of_idx(self) -> List[int]:
+        """Dense index -> raw aid (for fill-event reconstruction)."""
+        out = [0] * len(self.aid_idx)
+        for aid, idx in self.aid_idx.items():
+            out[idx] = aid
+        return out
+
+    def sid_of_lane(self) -> Dict[int, int]:
+        return {lane: sid for sid, lane in self.sid_lane.items()}
+
+    # -- planning ----------------------------------------------------------
+
+    def plan(self, msgs: Sequence[OrderMsg]) -> Schedule:
+        """Greedy conflict-free placement of a message batch."""
+        placements: List[Placed] = []
+        barriers: List[Barrier] = []
+        host_rejects: List[HostReject] = []
+        segment_steps: List[int] = []
+        program: List[tuple] = []
+
+        lane_next = [0] * self.S
+        actor_next: Dict[int, int] = {}
+        seg = 0
+        seg_height = 0  # steps used so far in the current segment
+
+        def close_segment():
+            nonlocal seg, seg_height, lane_next
+            if seg_height > 0:
+                segment_steps.append(seg_height)
+                program.append(("scan", len(segment_steps) - 1))
+                seg += 1
+            lane_next = [0] * self.S
+            for k in actor_next:
+                actor_next[k] = 0
+            seg_height = 0
+
+        def place(i: int, lane: int, lane_act: int, aidx: int,
+                  m: OrderMsg, actor_key: Optional[int]) -> None:
+            nonlocal seg_height
+            step = lane_next[lane]
+            if actor_key is not None:
+                step = max(step, actor_next.get(actor_key, 0))
+            placements.append(Placed(i, seg, step, lane, lane_act, aidx,
+                                     m.oid, m.price, m.size))
+            lane_next[lane] = step + 1
+            if actor_key is not None:
+                actor_next[actor_key] = step + 1
+            seg_height = max(seg_height, step + 1)
+
+        def free_lane(step_floor: int) -> int:
+            # prefer a lane whose clock is <= the actor clock (no stall)
+            for probe in range(self.S):
+                lane = (self._rr_lane + probe) % self.S
+                if lane_next[lane] <= step_floor:
+                    self._rr_lane = (lane + 1) % self.S
+                    return lane
+            lane = min(range(self.S), key=lane_next.__getitem__)
+            self._rr_lane = (lane + 1) % self.S
+            return lane
+
+        for i, m in enumerate(msgs):
+            a = m.action
+            if not (-2**31 <= m.price < 2**31 and -2**31 <= m.size < 2**31):
+                raise EnvelopeError(
+                    f"message {i}: price/size outside int32 "
+                    f"(price={m.price}, size={m.size})")
+            if a in _TRADE_ACTS:
+                lane = self._lane(m.sid)
+                aidx = self._acct(m.aid)
+                self.oid_sid[m.oid] = m.sid
+                place(i, lane, _TRADE_ACTS[a], aidx, m, actor_key=m.aid)
+            elif a == op.CANCEL:
+                # route stays mapped even after a cancel attempt: a cancel
+                # can fail (wrong owner) and be retried, and a second
+                # cancel of a gone order correctly rejects on device
+                sid = self.oid_sid.get(m.oid)
+                if sid is None:
+                    host_rejects.append(HostReject(i))
+                    continue
+                lane = self._lane(sid)
+                aidx = self._acct(m.aid)
+                place(i, lane, L.L_CANCEL, aidx, m, actor_key=m.aid)
+            elif a == op.CREATE_BALANCE:
+                aidx = self._acct(m.aid)
+                step_floor = actor_next.get(m.aid, 0)
+                lane = free_lane(step_floor)
+                place(i, lane, L.L_CREATE, aidx, m, actor_key=m.aid)
+            elif a == op.TRANSFER:
+                aidx = self._acct(m.aid)
+                step_floor = actor_next.get(m.aid, 0)
+                lane = free_lane(step_floor)
+                place(i, lane, L.L_TRANSFER, aidx, m, actor_key=m.aid)
+            elif a == op.ADD_SYMBOL:
+                if m.sid < 0:
+                    host_rejects.append(HostReject(i))
+                    continue
+                lane = self._lane(m.sid)
+                place(i, lane, L.L_ADD_SYMBOL, 0, m, actor_key=None)
+            elif a in (op.REMOVE_SYMBOL, op.PAYOUT):
+                s = abs(m.sid)
+                if s not in self.sid_lane:
+                    host_rejects.append(HostReject(i))
+                    continue
+                lane = self.sid_lane[s]
+                close_segment()
+                if a == op.REMOVE_SYMBOL:
+                    mode = 0
+                else:
+                    mode = 1 if m.sid >= 0 else 2
+                barriers.append(Barrier(i, lane, mode, m.size))
+                program.append(("barrier", len(barriers) - 1))
+                # a wiped lane may be re-added later; resting-oid routes
+                # die with the wipe
+                dead = [o for o, s2 in self.oid_sid.items() if s2 == s]
+                for o in dead:
+                    del self.oid_sid[o]
+            else:
+                host_rejects.append(HostReject(i))  # unknown opcode
+        close_segment()
+        return Schedule(placements, barriers, host_rejects, segment_steps,
+                        program)

@@ -1,0 +1,208 @@
+"""LaneSession: the host half of the throughput engine.
+
+Plans a message batch (runtime/sequencer.py), packs scan segments into
+(T, S) device arrays, dispatches the lane step / barrier ops, and
+reconstructs the byte-exact output record stream in arrival order — the
+same IN / fills / OUT contract the reference forwards per message
+(KProcessor.java:97, 272-273, 124) and the oracle reproduces.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+import kme_tpu._jaxsetup  # noqa: F401
+import jax
+
+from kme_tpu import opcodes as op
+from kme_tpu.engine import lanes as L
+from kme_tpu.runtime.sequencer import Schedule, Scheduler
+from kme_tpu.wire import OrderMsg, OutRecord
+
+_LERR_NAMES = {
+    L.LERR_BOOK_FULL: "book slot capacity exhausted",
+    L.LERR_FILLS_FULL: "sweep crossed more makers than max_fills",
+}
+
+
+class LaneEngineError(RuntimeError):
+    def __init__(self, code: int) -> None:
+        self.code = int(code)
+        super().__init__(
+            f"lane engine error: {_LERR_NAMES.get(self.code, self.code)}")
+
+
+class LaneSession:
+    """Drop-in fixed-mode engine over the vmapped lane kernel.
+
+    With shards > 1 the lane axis is sharded over a device mesh
+    (kme_tpu/parallel/mesh.py); the output stream is bit-identical for
+    any shard count — the determinism contract of SURVEY.md §5."""
+
+    def __init__(self, cfg: L.LaneConfig, shards: int = 1) -> None:
+        self.cfg = cfg
+        self.shards = shards
+        if shards > 1:
+            from kme_tpu.parallel import mesh as M
+
+            self.mesh = M.build_mesh(shards)
+            self.state = M.shard_state(L.make_lane_state(cfg), self.mesh)
+            self._step = jax.jit(M.build_sharded_step(cfg, self.mesh),
+                                 donate_argnums=(0,))
+            self._settle = jax.jit(M.build_sharded_settle(cfg, self.mesh),
+                                   donate_argnums=(0,))
+        else:
+            self.mesh = None
+            self.state = L.make_lane_state(cfg)
+            self._step = jax.jit(L.build_lane_step(cfg), donate_argnums=(0,))
+            self._settle = jax.jit(L.build_barrier_ops(cfg), donate_argnums=(0,))
+        self.scheduler = Scheduler(cfg.lanes, cfg.accounts)
+
+    # ------------------------------------------------------------------
+
+    def _pack_segment(self, sched: Schedule, seg: int) -> Dict[str, np.ndarray]:
+        T, S = self.cfg.steps, self.cfg.lanes
+        height = sched.segment_steps[seg]
+        padded = ((height + T - 1) // T) * T
+        arr = {
+            "act": np.zeros((padded, S), np.int32),
+            "oid": np.zeros((padded, S), np.int64),
+            "aid": np.zeros((padded, S), np.int32),
+            "price": np.zeros((padded, S), np.int32),
+            "size": np.zeros((padded, S), np.int32),
+        }
+        from kme_tpu.oracle import javalong as jl
+
+        for p in sched.placements:
+            if p.segment != seg:
+                continue
+            arr["act"][p.step, p.lane] = p.lane_act
+            arr["oid"][p.step, p.lane] = jl.jlong(p.oid)
+            arr["aid"][p.step, p.lane] = p.aid_idx
+            arr["price"][p.step, p.lane] = p.price  # int32 by EnvelopeError
+            arr["size"][p.step, p.lane] = p.size
+        return arr
+
+    def _run_segment(self, arrs: Dict[str, np.ndarray]):
+        """Dispatch in T-sized chunks; returns list of chunk outputs."""
+        T = self.cfg.steps
+        chunks = []
+        total = arrs["act"].shape[0]
+        for t0 in range(0, total, T):
+            batch = {k: v[t0:t0 + T] for k, v in arrs.items()}
+            self.state, outs = self._step(self.state, batch)
+            outs = jax.tree.map(np.asarray, outs)
+            err = outs["err"]
+            if err[-1] != L.LERR_OK:
+                raise LaneEngineError(int(err[-1]))
+            chunks.append(outs)
+        return chunks
+
+    # ------------------------------------------------------------------
+
+    def process(self, msgs: Sequence[OrderMsg]) -> List[List[OutRecord]]:
+        sched = self.scheduler.plan(msgs)
+        idx_to_aid = self.scheduler.acct_of_idx()
+        lane_to_sid = self.scheduler.sid_of_lane()
+
+        seg_out = {}
+        barrier_ok = {}
+        for kind, idx in sched.program:
+            if kind == "scan":
+                seg_out[idx] = self._run_segment(self._pack_segment(sched, idx))
+            else:
+                b = sched.barriers[idx]
+                from kme_tpu.oracle import javalong as jl
+
+                self.state, ok = self._settle(
+                    self.state, np.int32(b.lane),
+                    np.int64(jl.jlong(b.credit_size)), np.int32(b.mode))
+                barrier_ok[b.msg_index] = bool(np.asarray(ok))
+
+        placed_by_msg = {p.msg_index: p for p in sched.placements}
+        rejects = {r.msg_index for r in sched.host_rejects}
+        barriers_by_msg = {b.msg_index: b for b in sched.barriers}
+
+        out: List[List[OutRecord]] = []
+        T = self.cfg.steps
+        for i, m in enumerate(msgs):
+            recs = [OutRecord("IN", m.copy())]
+            if i in rejects:
+                echo = m.copy()
+                echo.action = op.REJECT
+                recs.append(OutRecord("OUT", echo))
+            elif i in barriers_by_msg:
+                echo = m.copy()
+                if not barrier_ok[i]:
+                    echo.action = op.REJECT
+                recs.append(OutRecord("OUT", echo))
+            else:
+                p = placed_by_msg[i]
+                chunk = seg_out[p.segment][p.step // T]
+                t = p.step % T
+                lane = p.lane
+                ok = bool(chunk["ok"][t, lane])
+                is_trade = p.lane_act in (L.L_BUY, L.L_SELL)
+                if is_trade and ok:
+                    sid = lane_to_sid[lane]
+                    is_buy = p.lane_act == L.L_BUY
+                    nf = int(chunk["nfill"][t, lane])
+                    for e in range(nf):
+                        fsz = int(chunk["fill_size"][t, lane, e])
+                        moid = int(chunk["fill_oid"][t, lane, e])
+                        maid = idx_to_aid[int(chunk["fill_aid"][t, lane, e])]
+                        mprice = int(chunk["fill_price"][t, lane, e])
+                        recs.append(OutRecord("OUT", OrderMsg(
+                            action=op.SOLD if is_buy else op.BOUGHT,
+                            oid=moid, aid=maid, sid=sid, price=0, size=fsz)))
+                        recs.append(OutRecord("OUT", OrderMsg(
+                            action=op.BOUGHT if is_buy else op.SOLD,
+                            oid=m.oid, aid=m.aid, sid=sid,
+                            price=m.price - mprice, size=fsz)))
+                echo = m.copy()
+                if not ok:
+                    echo.action = op.REJECT
+                if is_trade and ok:
+                    echo.size = int(chunk["residual"][t, lane])
+                    if bool(chunk["append"][t, lane]):
+                        echo.prev = int(chunk["prev_oid"][t, lane])
+                recs.append(OutRecord("OUT", echo))
+            out.append(recs)
+        return out
+
+    # ------------------------------------------------------------------
+
+    def export_state(self) -> Dict[str, dict]:
+        """Host dict view comparable to the oracle's stores (fixed mode)."""
+        s = jax.tree.map(np.asarray, self.state)
+        idx_to_aid = self.scheduler.acct_of_idx()
+        lane_to_sid = self.scheduler.sid_of_lane()
+        balances = {idx_to_aid[i]: int(s["bal"][i])
+                    for i in range(len(idx_to_aid)) if s["bal_used"][i]}
+        positions = {}
+        orders = {}
+        S, _, N = s["slot_oid"].shape
+        for lane in range(S):
+            sid = lane_to_sid.get(lane)
+            if sid is None:
+                continue
+            for a in range(len(idx_to_aid)):
+                if s["pos_used"][lane, a]:
+                    positions[(idx_to_aid[a], sid)] = (
+                        int(s["pos_amt"][lane, a]), int(s["pos_avail"][lane, a]))
+            for side in range(2):
+                for n in range(N):
+                    if s["slot_used"][lane, side, n]:
+                        orders[int(s["slot_oid"][lane, side, n])] = {
+                            "aid": idx_to_aid[int(s["slot_aid"][lane, side, n])],
+                            "sid": sid,
+                            "price": int(s["slot_price"][lane, side, n]),
+                            "size": int(s["slot_size"][lane, side, n]),
+                            "is_buy": side == 0,
+                        }
+        books = {sid: True for sid, lane in self.scheduler.sid_lane.items()
+                 if s["book_exists"][lane]}
+        return {"balances": balances, "positions": positions,
+                "orders": orders, "books": books}

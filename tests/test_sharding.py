@@ -1,0 +1,63 @@
+"""Sharded engine: determinism and exactness over the symbol mesh.
+
+SURVEY.md §5 race-detection analog: same input log => bit-identical
+output for ANY shard count. Runs on the 8-device virtual CPU mesh.
+"""
+
+import pytest
+
+from kme_tpu.engine.lanes import LaneConfig
+from kme_tpu.oracle import OracleEngine
+from kme_tpu.runtime.session import LaneSession
+from kme_tpu.workload import zipf_symbol_stream
+
+
+@pytest.mark.slow
+def test_sharded_determinism_and_oracle_parity(cpu_devices):
+    msgs = zipf_symbol_stream(1500, num_symbols=16, num_accounts=32, seed=4)
+    cfg = LaneConfig(lanes=16, slots=128, accounts=64, max_fills=32, steps=32)
+
+    ora = OracleEngine("fixed")
+    want = [[r.wire() for r in ora.process(m.copy())] for m in msgs]
+
+    streams = {}
+    states = {}
+    for shards in (1, 2, 8):
+        ses = LaneSession(cfg, shards=shards)
+        got = ses.process(msgs)
+        streams[shards] = [[r.wire() for r in recs] for recs in got]
+        states[shards] = ses.export_state()
+
+    for shards in (1, 2, 8):
+        assert streams[shards] == want, f"oracle parity broken at shards={shards}"
+    assert states[2] == states[1] and states[8] == states[1]
+
+
+def test_sharded_barrier_ops(cpu_devices):
+    """Payout/remove across shards: the owning shard wipes; balances are
+    psum-merged identically everywhere."""
+    import kme_tpu.opcodes as op
+    from kme_tpu.wire import OrderMsg
+
+    cfg = LaneConfig(lanes=4, slots=16, accounts=16, max_fills=8, steps=8)
+    msgs = [OrderMsg(action=op.CREATE_BALANCE, aid=1),
+            OrderMsg(action=op.TRANSFER, aid=1, size=100000),
+            OrderMsg(action=op.CREATE_BALANCE, aid=2),
+            OrderMsg(action=op.TRANSFER, aid=2, size=100000)]
+    for s in range(4):
+        msgs.append(OrderMsg(action=op.ADD_SYMBOL, sid=s))
+    for s in range(4):
+        msgs.append(OrderMsg(action=op.BUY, oid=10 + s, aid=1, sid=s,
+                             price=50, size=3))
+        msgs.append(OrderMsg(action=op.SELL, oid=20 + s, aid=2, sid=s,
+                             price=45, size=2))
+    msgs += [OrderMsg(action=op.PAYOUT, sid=2, size=97),
+             OrderMsg(action=op.REMOVE_SYMBOL, sid=3),
+             OrderMsg(action=op.PAYOUT, sid=-1, size=97)]
+
+    ora = OracleEngine("fixed")
+    want = [[r.wire() for r in ora.process(m.copy())] for m in msgs]
+    ses = LaneSession(cfg, shards=4)
+    got = [[r.wire() for r in recs] for recs in ses.process(msgs)]
+    assert got == want
+    assert ses.export_state()["balances"] == dict(ora.balances)
