@@ -139,7 +139,10 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
 
         # ------------------------------------------------------- TRANSFER
         size64 = size.astype(_I64)
-        transfer_ok = (act == L_TRANSFER) & bal_ok & ~(bal_g < -size64)
+        # `-order.size` is Java int negation: wraps at int32 (INT_MIN stays
+        # INT_MIN) before the long comparison — mirrors oracle._transfer
+        neg_size64 = (-size).astype(_I64)
+        transfer_ok = (act == L_TRANSFER) & bal_ok & ~(bal_g < neg_size64)
 
         # ----------------------------------------------------- ADD_SYMBOL
         addsym_ok = (act == L_ADD_SYMBOL) & ~st["book_exists"]
@@ -217,7 +220,9 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         #   avail_fin  = sum(fills after last zero prefix)   if any zero
         #              = avail0 + sum(fills)                 otherwise
         # This replaces a 2E-deep sequential loop with a few (S,2E,2E)
-        # einsums — pure VPU/MXU work, no serialization.
+        # masked reductions — pure VPU work, no serialization. (Masked
+        # where+sum rather than int64 einsum: an s64 dot_general hits
+        # XLA:TPU's unimplemented X64-rewrite path and fails to compile.)
         twoE = 2 * E
         idx2 = jnp.arange(twoE, dtype=_I32)
         li = lane_ids[:, None]
@@ -236,14 +241,15 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         eq = ((acc[:, :, None] == acc[:, None, :])
               & fvalid[:, :, None] & fvalid[:, None, :])     # (S, i, j)
         le = idx2[:, None] <= idx2[None, :]
-        prefix = a0 + jnp.einsum("sij,si->sj", (eq & le[None]).astype(_I64), sgn)
+        sgn_b = sgn[:, :, None]                              # (S, i, 1)
+        prefix = a0 + jnp.sum(jnp.where(eq & le[None], sgn_b, 0), axis=1)
         zero = fvalid & (prefix == 0)
         # per entry j: index of its account's last zero prefix (-1 if none)
         jlast = jnp.max(
             jnp.where(zero[:, :, None] & eq, idx2[None, :, None], -1), axis=1)
         after = eq & (idx2[None, :, None] > jlast[:, None, :])
-        avail_sum = jnp.einsum("sij,si->sj", after.astype(_I64), sgn)
-        total = jnp.einsum("sij,si->sj", eq.astype(_I64), sgn)
+        avail_sum = jnp.sum(jnp.where(after, sgn_b, 0), axis=1)
+        total = jnp.sum(jnp.where(eq, sgn_b, 0), axis=1)
         anyzero = jnp.any(zero[:, :, None] & eq, axis=1)
         amt_fin = a0 + total
         avail_fin = jnp.where(anyzero, avail_sum, v0 + total)
@@ -263,11 +269,13 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         pos_used = _scat(st["pos_used"], used_fin)
 
         # taker balance credit: sum of fill * improvement (maker credit is
-        # size * 0 == 0 — the structural fact the scheduler relies on)
+        # size * 0 == 0 — the structural fact the scheduler relies on).
+        # Each per-fill product is Java int*int — wraps at int32 BEFORE
+        # the long balance add (KProcessor.java:286, oracle._fill_order)
         improve = (jnp.where(trade_ok[:, None], price[:, None], 0)
-                   - fo_price).astype(_I64)
-        signed_credit = jnp.where(is_buy[:, None], fo_fill, -fo_fill).astype(_I64)
-        credit = jnp.sum(signed_credit * improve, axis=1)
+                   - fo_price).astype(_I32)
+        signed_credit = jnp.where(is_buy[:, None], fo_fill, -fo_fill).astype(_I32)
+        credit = jnp.sum((signed_credit * improve).astype(_I64), axis=1)
 
         # ------------------------------------------------- TRADE: rest
         rest = trade_ok & (residual > 0)

@@ -323,7 +323,9 @@ def _h_transfer(st, err, msg, outbuf, java):
     guarded by `balance < -size`."""
     bal, found = _bal_get(st, msg["aid"])
     size = msg["size"].astype(_I64)
-    ok = found & ~(bal < -size)
+    # `-order.size` is Java int negation: wraps at int32 before promotion
+    neg_size = (-msg["size"]).astype(_I64)
+    ok = found & ~(bal < neg_size)
     st2, err2 = _bal_put(st, err, msg["aid"], bal + size)
     st = _sel(ok, st2, st)
     err = jnp.where(ok, err2, err)
@@ -368,6 +370,9 @@ def _check_balance(st, err, aid, sid, price, is_buy, size_in, java):
     ok = found & ~(bal < risk)
     st2, err2 = _bal_put(st, err, aid, bal - risk)
     adj_write = ok & (adj != 0)
+    # adj != 0 with no position (negative size): getPositionAmount(null)
+    # NPE (KProcessor.java:179-180) — after the balance debit persisted
+    err2 = _guard(err2, adj_write & ~pos_found, ERR_CRASH)
     # adj-write uses the REAL key (3-arg setPosition, KProcessor.java:179)
     st3, err3 = _pos_put(st2, err2, aid.astype(_I64), sid.astype(_I64),
                          amt, avail - adj)
@@ -395,7 +400,10 @@ def _post_remove_adjustments(st, err, rec, java):
     err = _guard(err, ~found, ERR_CRASH)  # NPE: release with no balance
     unit = jnp.where(is_buy, rec["ord_price"], rec["ord_price"] - 100).astype(_I64)
     st, err = _bal_put(st, err, aid, bal + (size + adj) * unit)
-    adj_write = adj != 0  # implies pos_found
+    adj_write = adj != 0
+    # adj != 0 with no position (negative-size rec): the JVM NPEs at
+    # getPositionAmount(null) (KProcessor.java:332) after the credit above
+    err = _guard(err, adj_write & ~pos_found, ERR_CRASH)
     tka = jnp.where(jnp.asarray(java), amt, aid)    # Q11 target
     tks = jnp.where(jnp.asarray(java), avail, sid)
     st2, err2 = _pos_put(st, err, tka, tks, amt, avail + adj)
@@ -418,7 +426,8 @@ def _fill_order(st, err, outbuf, action, oid, aid, sid, price, size, java,
                        events)
     n = jnp.where(do, n + 1, n)
 
-    signed = jnp.where(action == op.BOUGHT, size, -size).astype(_I32).astype(_I64)
+    signed32 = jnp.where(action == op.BOUGHT, size, -size).astype(_I32)
+    signed = signed32.astype(_I64)
     ka, ks = aid.astype(_I64), sid.astype(_I64)
     amt, avail, found = _pos_get(st, ka, ks)
     # create path
@@ -436,7 +445,10 @@ def _fill_order(st, err, outbuf, action, oid, aid, sid, price, size, java,
 
     bal, bfound = _bal_get(st, aid)
     err = _guard(err, ~bfound, ERR_CRASH)  # NPE: fill with no balance
-    st, err = _bal_put(st, err, aid, bal + signed * price.astype(_I64))
+    # `size * order.price` is int*int — int32 wrap before the long add
+    # (KProcessor.java:286)
+    credit = (signed32 * price.astype(_I32)).astype(_I64)
+    st, err = _bal_put(st, err, aid, bal + credit)
     return st, err, (events, n)
 
 

@@ -212,7 +212,9 @@ class OracleEngine:
         """transfer (KProcessor.java:140-146): deposit/withdraw guarded by
         `balance < -size`."""
         bal = self.balances.get(order.aid)
-        if bal is None or bal < -order.size:
+        # `-order.size` is Java int negation: wraps at int32 (stays INT_MIN
+        # for size = INT_MIN) before promotion to long for the comparison
+        if bal is None or bal < jl.jint(-order.size):
             return False
         self.balances[order.aid] = jl.jadd(bal, order.size)
         return True
@@ -356,7 +358,13 @@ class OracleEngine:
             return False
         self.balances[aid] = jl.jadd(bal, -risk)
         if adj != 0:
-            # pos is non-None here: adj != 0 requires available != 0
+            # adj != 0 with no position is reachable for negative sizes
+            # (available=0, -size > 0): the JVM NPEs at
+            # getPositionAmount(null) (KProcessor.java:179-180) AFTER the
+            # balance debit above persisted
+            if pos is None:
+                raise ReferenceCrash(
+                    "NPE: checkBalance adj-write with no position")
             self.positions[(aid, order.sid)] = (pos[0], jl.jadd(available, -adj))
         return True
 
@@ -381,6 +389,12 @@ class OracleEngine:
             bal, jl.jmul(jl.jadd(size, adj),
                          jl.jint(rec.price) if is_buy else jl.jint(rec.price - 100)))
         if adj != 0:
+            # same NPE shape as checkBalance: adj != 0 with pos None
+            # (negative-size rec) dies at getPositionAmount(null)
+            # (KProcessor.java:332) after the balance credit persisted
+            if pos is None:
+                raise ReferenceCrash(
+                    "NPE: postRemoveAdjustments adj-write with no position")
             target = pos if self.java else (rec.aid, rec.sid)  # Q11
             self.positions[target] = (pos[0], jl.jadd(pos[1], adj))
 
@@ -558,7 +572,9 @@ class OracleEngine:
         bal = self.balances.get(fill.aid)
         if bal is None:
             raise ReferenceCrash("NPE: fill credits account with no balance")
-        self.balances[fill.aid] = jl.jadd(bal, jl.jmul(size, fill.price))
+        # `size * order.price` is int*int — wraps at int32 BEFORE the long
+        # promotion of the balance add (KProcessor.java:286)
+        self.balances[fill.aid] = jl.jadd(bal, jl.jint(size * fill.price))
 
     # ------------------------------------------------------------------
     # cancel path (KProcessor.java:289-323)

@@ -151,3 +151,31 @@ def test_lane_slot_overflow_flagged():
                       size=1) for i in range(5)]
     with pytest.raises(LaneEngineError):
         ses.process(msgs)
+
+
+def test_lane_fill_credit_wraps_at_int32():
+    """Per-fill taker credit is Java int*int — wraps at int32 before the
+    long balance add (oracle._fill_order after the round-2 fix); the
+    lanes engine must wrap identically."""
+    msgs = []
+    for a in (0, 1):
+        msgs.append(OrderMsg(action=op.CREATE_BALANCE, aid=a))
+        for _ in range(3):
+            msgs.append(OrderMsg(action=op.TRANSFER, aid=a, size=2**31 - 1))
+    msgs.append(OrderMsg(action=op.ADD_SYMBOL, sid=0))
+    msgs.append(OrderMsg(action=op.SELL, oid=1, aid=0, sid=0, price=0,
+                         size=2**25))
+    msgs.append(OrderMsg(action=op.BUY, oid=2, aid=1, sid=0, price=125,
+                         size=2**25))
+    assert_lane_parity(msgs)
+
+
+def test_lane_transfer_int_min_negation_wraps():
+    """`-order.size` negates in int32 (INT_MIN stays INT_MIN): the
+    size=INT_MIN withdrawal is ACCEPTED — lanes must mirror the oracle."""
+    msgs = [
+        OrderMsg(action=op.CREATE_BALANCE, aid=1),
+        OrderMsg(action=op.TRANSFER, aid=1, size=-(2**31)),
+    ]
+    ses, ora = assert_lane_parity(msgs)
+    assert ora.balances[1] == -(2**31)

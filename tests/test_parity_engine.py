@@ -260,3 +260,48 @@ def test_parity_transfer_and_balance_edges():
     ]
     assert_parity(msgs, "java")
     assert_parity(msgs, "fixed")
+
+
+def test_parity_fill_credit_wraps_at_int32():
+    """fillOrder's balance credit is `size * order.price` — an int*int
+    product that wraps at 2^31 BEFORE the long promotion of the balance
+    add (KProcessor.java:286). size=65536 at improvement=32768 crosses
+    the boundary exactly."""
+    msgs = []
+    for a in (0, 1):
+        msgs.append(OrderMsg(action=op.CREATE_BALANCE, aid=a))
+        for _ in range(2):
+            msgs.append(OrderMsg(action=op.TRANSFER, aid=a, size=2**30))
+    msgs.append(OrderMsg(action=op.ADD_SYMBOL, sid=1))
+    msgs.append(OrderMsg(action=op.SELL, oid=1, aid=0, sid=1, price=0,
+                         size=65536))
+    msgs.append(OrderMsg(action=op.BUY, oid=2, aid=1, sid=1, price=32768,
+                         size=65536))
+    ora, dev = assert_parity(msgs, "java")
+    # taker: margin debit 2^31 (long), fill credit jint(2^31) = -2^31
+    assert ora.balances[1] == 2 * 2**30 - 2**31 - 2**31
+
+
+def test_parity_transfer_int_min_negation_wraps():
+    """`balance < -order.size` negates in 32-bit int: -INT_MIN stays
+    INT_MIN, so a withdrawal of 2^31 is ACCEPTED by the JVM."""
+    msgs = [
+        OrderMsg(action=op.CREATE_BALANCE, aid=1),
+        OrderMsg(action=op.TRANSFER, aid=1, size=-(2**31)),
+    ]
+    ora, dev = assert_parity(msgs, "java")
+    assert ora.balances[1] == -(2**31)
+    assert_parity(msgs, "fixed")
+
+
+def test_parity_negative_size_buy_npe():
+    """A BUY with negative size and no position: checkBalance's adj-write
+    hits getPositionAmount(null) (KProcessor.java:179-180) AFTER the
+    balance debit persisted — both engines die at the same index."""
+    msgs = _seeded(num_accounts=1, symbols=(1,))
+    msgs.append(OrderMsg(action=op.BUY, oid=1, aid=0, sid=1, price=50,
+                         size=-5))
+    ora_recs, ora_death, _ = run_oracle(msgs, "java")
+    dev_recs, dev_death, _ = run_device(msgs, "java")
+    assert ora_death == dev_death == len(msgs) - 1
+    assert dev_recs == ora_recs
