@@ -4,14 +4,15 @@ The reference publishes no numbers (BASELINE.md); its structural bound is
 single-digit-thousands of orders/sec (serial awaited produce per order,
 commit per record, JSON serde, RocksDB round-trips — BASELINE.md table).
 `REFERENCE_BASELINE_OPS` pins the top of that band (5k orders/sec) as the
-denominator for `vs_baseline`, documented here so the ratio is honest and
-reproducible.
+denominator for `vs_baseline`. The environment has no JVM (no `java` on
+PATH), so the reference cannot be measured here; the assumption and its
+basis are documented in BASELINE.md and printed in the detail line.
 
-Headline metric: matched orders/sec through the vmapped lane engine
-(device dispatch phase) across 1k symbols — the BASELINE.md "1k symbols ×
-100k orders" row. Host planning/packing and record reconstruction are
-timed separately (they pipeline with device work in the serving path and
-are the C++ runtime's optimization target).
+Headline metric: END-TO-END orders/sec through the lane engine on the
+BASELINE.md "1k symbols x 100k orders, Zipf-skewed" row — plan + pack +
+device dispatch + output fetch + full record-stream reconstruction, with
+fill parity vs the scalar oracle asserted on a prefix of the same stream
+inside the run. Phase timings are reported in the detail line.
 """
 
 from __future__ import annotations
@@ -23,14 +24,27 @@ import time
 REFERENCE_BASELINE_OPS = 5_000.0  # orders/sec, derived bound (BASELINE.md)
 
 
+def _assert_parity_prefix(msgs, cfg, shards, prefix: int) -> None:
+    """Replay `prefix` messages through a throwaway session and the
+    scalar oracle; require byte-identical wire streams."""
+    from kme_tpu.oracle import OracleEngine
+    from kme_tpu.runtime.session import LaneSession
+
+    ses = LaneSession(cfg, shards=shards)
+    ora = OracleEngine("fixed")
+    got = ses.process(msgs[:prefix])
+    for i in range(prefix):
+        want = [r.wire() for r in ora.process(msgs[i].copy())]
+        g = [r.wire() for r in got[i]]
+        assert g == want, f"bench parity prefix diverged at message {i}"
+
+
 def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
                       accounts: int = 2048, seed: int = 0,
-                      zipf_a: float = 0.0, steps: int = 64,
+                      zipf_a: float = 1.2, steps: int = 64,
                       slots: int = 64, max_fills: int = 16,
-                      shards: int = 1) -> dict:
-    """Lane-engine throughput: plan+pack (host), dispatch (device, timed
-    as the headline), reconstruct (host). Fill parity is asserted on a
-    prefix via the scalar oracle elsewhere (tests); here we count fills."""
+                      shards: int = 1, parity_prefix: int = 2000) -> dict:
+    """End-to-end lane-engine throughput (see module docstring)."""
     import jax
 
     from kme_tpu.engine.lanes import LaneConfig
@@ -42,53 +56,56 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
     msgs = zipf_symbol_stream(events, num_symbols=symbols,
                               num_accounts=accounts, seed=seed,
                               zipf_a=zipf_a)
-    ses = LaneSession(cfg, shards=shards)
 
+    # correctness inside the bench: oracle parity on a stream prefix
+    _assert_parity_prefix(msgs, cfg, shards, min(parity_prefix, len(msgs)))
+
+    # warmup run on a fresh session: compiles every (T, M, F) bucket the
+    # timed run will hit (compiled executables are shared via the
+    # module-level chunk cache)
+    LaneSession(cfg, shards=shards).process(msgs)
+
+    # timed run, phase by phase (sum = the honest end-to-end number)
+    ses = LaneSession(cfg, shards=shards)
     t0 = time.perf_counter()
     sched = ses.scheduler.plan(msgs)
     t_plan = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    packed = [ses._pack_segment(sched, i) for i in range(len(sched.segment_steps))]
-    t_pack = time.perf_counter() - t0
-
-    # warmup compile on a zero batch of the same shape
-    T = cfg.steps
-    warm = {k: v[:T] * 0 for k, v in packed[0].items()}
-    st, _ = ses._step(ses.state, warm)
-    ses.state = st
-    jax.block_until_ready(ses.state)
-
-    t0 = time.perf_counter()
-    chunks = [ses._run_segment(arrs) for arrs in packed]
+    seg_runs, barrier_ok = ses._dispatch(sched)   # pack + async dispatch
     jax.block_until_ready(ses.state)
     t_disp = time.perf_counter() - t0
 
-    # reconstruction (host): reuse session plumbing by replaying the
-    # chunk outputs through the record builder
     t0 = time.perf_counter()
-    fills = 0
-    for segchunks in chunks:
-        for ch in segchunks:
-            fills += int(ch["nfill"].sum())
+    ses._fetch(seg_runs)
+    t_fetch = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    records = ses._reconstruct(msgs, sched, seg_runs, barrier_ok)
     t_recon = time.perf_counter() - t0
 
     n = len(msgs)
+    total = t_plan + t_disp + t_fetch + t_recon
+    fills = sum(int(r.host["nfill_total"]) for r in seg_runs.values())
+    n_records = sum(len(r) for r in records)
     steps_total = sum(sched.segment_steps)
-    ops = n / t_disp
+    ops = n / total
     return {
-        "metric": "orders_per_sec_lane_engine",
+        "metric": "orders_per_sec_e2e",
         "value": round(ops, 1),
         "unit": "orders/s",
         "vs_baseline": round(ops / REFERENCE_BASELINE_OPS, 3),
         "detail": {
             "events": n, "symbols": symbols, "accounts": accounts,
             "zipf_a": zipf_a, "shards": shards,
-            "dispatch_s": round(t_disp, 3), "plan_s": round(t_plan, 3),
-            "pack_s": round(t_pack, 3), "recon_scan_s": round(t_recon, 3),
+            "plan_s": round(t_plan, 3), "dispatch_s": round(t_disp, 3),
+            "fetch_s": round(t_fetch, 3), "recon_s": round(t_recon, 3),
+            "total_s": round(total, 3),
+            "device_orders_per_sec": round(n / max(t_disp + t_fetch, 1e-9), 1),
             "sched_steps": steps_total,
             "msgs_per_step": round(n / max(steps_total, 1), 1),
-            "trades": fills,
+            "trades": fills, "out_records": n_records,
+            "parity_prefix": parity_prefix,
             "backend": jax.devices()[0].platform,
             "baseline_assumption_ops": REFERENCE_BASELINE_OPS,
         },
@@ -135,7 +152,7 @@ def main(argv=None) -> int:
     p.add_argument("--events", type=int, default=None)
     p.add_argument("--symbols", type=int, default=1024)
     p.add_argument("--accounts", type=int, default=2048)
-    p.add_argument("--zipf", type=float, default=0.0)
+    p.add_argument("--zipf", type=float, default=1.2)
     p.add_argument("--shards", type=int, default=1)
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--seed", type=int, default=0)

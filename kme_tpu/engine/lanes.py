@@ -70,6 +70,7 @@ L_ADD_SYMBOL = 6
 LERR_OK = 0
 LERR_BOOK_FULL = 1    # resting-slot capacity exhausted (H2 envelope)
 LERR_FILLS_FULL = 2   # sweep crossed more than max_fills makers (H3)
+LERR_FILLBUF_FULL = 3  # chunk fill buffer exhausted (fills_per_msg knob)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,9 +79,11 @@ class LaneConfig:
 
     lanes: int = 8            # S — symbols (sharded axis)
     slots: int = 128          # N — resting orders per book side
-    accounts: int = 256       # A — dense account capacity
+    accounts: int = 256      # A — dense account capacity
     max_fills: int = 16       # E — makers swept per taker (H3 bound)
-    steps: int = 64           # T — scan steps per dispatch
+    steps: int = 64           # T bucket granularity of a dispatch window
+    window: int = 1024        # max scan steps per dispatch (HBM bound)
+    fill_buffer: int = 1 << 20  # device fill ring capacity (H3 envelope)
 
 
 def make_lane_state(cfg: LaneConfig):
@@ -100,6 +103,12 @@ def make_lane_state(cfg: LaneConfig):
         "bal": jnp.zeros((A,), _I64),
         "bal_used": jnp.zeros((A,), bool),
         "err": jnp.zeros((), _I32),
+        # persistent fill log: rows oid/aid/price/size, one slot of slack
+        # for clamped overflow writes; filloff = next free position. Only
+        # the used prefix ever crosses to the host (ONE sliced fetch per
+        # batch — the tunneled-TPU I/O design, see chunk_compaction).
+        "fillbuf": jnp.zeros((4, cfg.fill_buffer + 1), _I64),
+        "filloff": jnp.zeros((1,), _I64),
     }
 
 
@@ -122,6 +131,21 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
     S, N, A, E = cfg.lanes, cfg.slots, cfg.accounts, cfg.max_fills
     lane_ids = jnp.arange(S, dtype=_I32)
 
+    # TPU-friendly indexed access: multi-dim advanced indexing like
+    # a[lane_ids, side, idx] lowers to a generic (slow, ~ms) gather /
+    # scatter; take_along_axis / one-hot selects lower to vectorized VPU
+    # work (~20µs at S=1024). Measured on v5e — use ONLY these forms in
+    # the per-step path.
+    def _ta1(a, idx):
+        """a: (S, K), idx: (S,) -> (S,) — batched axis-1 gather."""
+        return jnp.take_along_axis(a, idx[:, None].astype(_I32), axis=1)[:, 0]
+
+    def _pa1(a, idx, vals):
+        """a: (S, K), idx: (S,) -> a with a[s, idx[s]] = vals[s]."""
+        return jnp.put_along_axis(a, idx[:, None].astype(_I32),
+                                  vals[:, None].astype(a.dtype), axis=1,
+                                  inplace=False)
+
     def one_step(st, msg):
         act, oid, aid = msg["act"], msg["oid"], msg["aid"]
         price, size = msg["price"], msg["size"]
@@ -130,6 +154,16 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         is_buy = act == L_BUY
         side = jnp.where(is_buy, 0, 1).astype(_I32)     # own (rest) side
         opp = (1 - side).astype(_I32)
+        opp_is0 = (opp == 0)[:, None]                   # (S, 1) side select
+        side_oh = (side[:, None] == jnp.arange(2, dtype=_I32))[:, :, None]
+        opp_oh = (opp[:, None] == jnp.arange(2, dtype=_I32))[:, :, None]
+
+        def pick_side(a, is0):
+            return jnp.where(is0, a[:, 0], a[:, 1])
+
+        def set_side(a, oh, new):
+            """a: (S,2,N); oh: (S,2,1) one-hot; new: (S,N) side image."""
+            return jnp.where(oh, new[:, None, :], a)
 
         bal_g = st["bal"][aid]              # (S,) pre-step actor balances
         bal_ok = st["bal_used"][aid]
@@ -154,9 +188,9 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         valid = (price >= 0) & (price < 126) & (size > 0)
         signed = jnp.where(is_buy, size, -size).astype(_I32)
         signed64 = signed.astype(_I64)
-        p_amt = st["pos_amt"][lane_ids, aid]
-        p_avail = jnp.where(st["pos_used"][lane_ids, aid],
-                            st["pos_avail"][lane_ids, aid], 0)
+        p_amt = _ta1(st["pos_amt"], aid)
+        p_avail = jnp.where(_ta1(st["pos_used"], aid),
+                            _ta1(st["pos_avail"], aid), 0)
         adj = jnp.where(is_buy,
                         jnp.maximum(jnp.minimum(p_avail, 0), -signed64),
                         jnp.minimum(jnp.maximum(p_avail, 0), -signed64))
@@ -165,13 +199,14 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         trade_ok = is_trade & valid & st["book_exists"] & bal_ok & ~(bal_g < risk)
         # margin netting blocks part of the opposite position (:179)
         adj_write = trade_ok & (adj != 0)
-        pos_avail = st["pos_avail"].at[lane_ids, aid].add(
-            jnp.where(adj_write, -adj, 0))
+        pos_avail = _pa1(st["pos_avail"], aid,
+                         _ta1(st["pos_avail"], aid)
+                         + jnp.where(adj_write, -adj, 0))
 
         # -------------------------------------------------- TRADE: sweep
         # the match loop (KProcessor.java:237-258) as one masked argsort +
         # prefix sum over the opposite side's slots
-        g = lambda a: a[lane_ids, opp]                 # (S, N) opp side
+        g = lambda a: pick_side(a, opp_is0)            # (S, N) opp side
         m_used = g(st["slot_used"])
         m_price, m_size = g(st["slot_price"]), g(st["slot_size"])
         m_oid, m_aid, m_seq = g(st["slot_oid"]), g(st["slot_aid"]), g(st["slot_seq"])
@@ -197,10 +232,10 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         fill_slot = jnp.take_along_axis(fill_sorted, inv, axis=1)
         new_m_size = (m_size - fill_slot).astype(_I32)
         new_m_used = m_used & (new_m_size > 0)
-        slot_size = st["slot_size"].at[lane_ids, opp].set(
-            jnp.where(trade_ok[:, None], new_m_size, m_size))
-        slot_used = st["slot_used"].at[lane_ids, opp].set(
-            jnp.where(trade_ok[:, None], new_m_used, m_used))
+        slot_size = set_side(st["slot_size"], opp_oh,
+                             jnp.where(trade_ok[:, None], new_m_size, m_size))
+        slot_used = set_side(st["slot_used"], opp_oh,
+                             jnp.where(trade_ok[:, None], new_m_used, m_used))
 
         # compact per-trade outputs (priority order), truncated at E
         fo_oid = take(m_oid)[:, :E]
@@ -225,7 +260,6 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         # XLA:TPU's unimplemented X64-rewrite path and fails to compile.)
         twoE = 2 * E
         idx2 = jnp.arange(twoE, dtype=_I32)
-        li = lane_ids[:, None]
         acc = jnp.zeros((S, twoE), _I32)
         acc = acc.at[:, 0::2].set(fo_aid).at[:, 1::2].set(
             jnp.broadcast_to(aid[:, None], (S, E)))
@@ -236,8 +270,9 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         fv = (fo_fill > 0) & trade_ok[:, None]
         fvalid = jnp.zeros((S, twoE), bool).at[:, 0::2].set(fv)
         fvalid = fvalid.at[:, 1::2].set(fv)
-        a0 = jnp.where(st["pos_used"][li, acc], st["pos_amt"][li, acc], 0)
-        v0 = jnp.where(st["pos_used"][li, acc], pos_avail[li, acc], 0)
+        pu_acc = jnp.take_along_axis(st["pos_used"], acc, axis=1)
+        a0 = jnp.where(pu_acc, jnp.take_along_axis(st["pos_amt"], acc, axis=1), 0)
+        v0 = jnp.where(pu_acc, jnp.take_along_axis(pos_avail, acc, axis=1), 0)
         eq = ((acc[:, :, None] == acc[:, None, :])
               & fvalid[:, :, None] & fvalid[:, None, :])     # (S, i, j)
         le = idx2[:, None] <= idx2[None, :]
@@ -261,7 +296,8 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         def _scat(arr, vals):
             pad = jnp.concatenate(
                 [arr, jnp.zeros((S, 1), arr.dtype)], axis=1)
-            pad = pad.at[li, acc_t].set(vals.astype(arr.dtype))
+            pad = jnp.put_along_axis(pad, acc_t, vals.astype(arr.dtype),
+                                     axis=1, inplace=False)
             return pad[:, :A]
 
         pos_amt = _scat(st["pos_amt"], jnp.where(used_fin, amt_fin, 0))
@@ -279,7 +315,8 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
 
         # ------------------------------------------------- TRADE: rest
         rest = trade_ok & (residual > 0)
-        own = lambda a: a[lane_ids, side]
+        side_is0 = (side == 0)[:, None]
+        own = lambda a: pick_side(a, side_is0)
         o_used = own(slot_used)  # after maker updates (opp side untouched)
         free_idx = jnp.argmax(~o_used, axis=1).astype(_I32)
         have_free = jnp.any(~o_used, axis=1)
@@ -292,22 +329,19 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         bucket_nonempty = jnp.any(same_level, axis=1)
         tail_idx = jnp.argmax(
             jnp.where(same_level, o_seq_, -1), axis=1).astype(_I32)
-        tail_oid = o_oid_arr[lane_ids, tail_idx]
+        tail_oid = _ta1(o_oid_arr, tail_idx)
 
         do_rest = rest & have_free
         seqno = st["seq"]
-        sidx = (lane_ids, side, free_idx)
-        slot_oid = st["slot_oid"].at[sidx].set(
-            jnp.where(do_rest, oid, st["slot_oid"][sidx]))
-        slot_aid = st["slot_aid"].at[sidx].set(
-            jnp.where(do_rest, aid, st["slot_aid"][sidx]))
-        slot_price = st["slot_price"].at[sidx].set(
-            jnp.where(do_rest, price, st["slot_price"][sidx]))
-        slot_size = slot_size.at[sidx].set(
-            jnp.where(do_rest, residual, slot_size[sidx]))
-        slot_seq = st["slot_seq"].at[sidx].set(
-            jnp.where(do_rest, seqno, st["slot_seq"][sidx]))
-        slot_used = slot_used.at[sidx].set(slot_used[sidx] | do_rest)
+        # one-hot write of the rested order into (lane, side, free_idx)
+        slot_oh = (free_idx[:, None] == jnp.arange(N, dtype=_I32))[:, None, :]
+        wr = side_oh & slot_oh & do_rest[:, None, None]      # (S, 2, N)
+        slot_oid = jnp.where(wr, oid[:, None, None], st["slot_oid"])
+        slot_aid = jnp.where(wr, aid[:, None, None], st["slot_aid"])
+        slot_price = jnp.where(wr, price[:, None, None], st["slot_price"])
+        slot_size = jnp.where(wr, residual[:, None, None], slot_size)
+        slot_seq = jnp.where(wr, seqno[:, None, None], st["slot_seq"])
+        slot_used = slot_used | wr
         seq = seqno + do_rest.astype(_I32)
 
         # --------------------------------------------------------- CANCEL
@@ -319,29 +353,29 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         hit_any = jnp.any(hit_flat, axis=1)
         hit_idx = jnp.argmax(hit_flat, axis=1).astype(_I32)
         h_side = hit_idx // N
-        h_slot = hit_idx % N
-        c_aid = st["slot_aid"][lane_ids, h_side, h_slot]
-        c_price = st["slot_price"][lane_ids, h_side, h_slot]
-        c_size = st["slot_size"][lane_ids, h_side, h_slot]
+        c_aid = _ta1(st["slot_aid"].reshape(S, 2 * N), hit_idx)
+        c_price = _ta1(st["slot_price"].reshape(S, 2 * N), hit_idx)
+        c_size = _ta1(st["slot_size"].reshape(S, 2 * N), hit_idx)
         cancel_ok = is_cancel & hit_any & (c_aid == aid)
-        cidx = (lane_ids, h_side, h_slot)
-        slot_used = slot_used.at[cidx].set(
-            slot_used[cidx] & ~cancel_ok)
+        clear = ((hit_idx[:, None] == jnp.arange(2 * N, dtype=_I32))
+                 & cancel_ok[:, None]).reshape(S, 2, N)
+        slot_used = slot_used & ~clear
         # margin release
         c_isbuy = h_side == 0
         c_signed = jnp.where(c_isbuy, c_size, -c_size).astype(_I64)
-        cp_amt = pos_amt[lane_ids, aid]
-        cp_avail = jnp.where(pos_used[lane_ids, aid],
-                             pos_avail[lane_ids, aid], 0)
-        blocked = jnp.where(pos_used[lane_ids, aid], cp_amt - cp_avail, 0)
+        cp_used = _ta1(pos_used, aid)
+        cp_amt = _ta1(pos_amt, aid)
+        cp_avail_raw = _ta1(pos_avail, aid)
+        cp_avail = jnp.where(cp_used, cp_avail_raw, 0)
+        blocked = jnp.where(cp_used, cp_amt - cp_avail, 0)
         c_adj = jnp.where(c_isbuy,
                           jnp.maximum(jnp.minimum(blocked, 0), -c_signed),
                           jnp.minimum(jnp.maximum(blocked, 0), -c_signed))
         c_unit = jnp.where(c_isbuy, c_price, c_price - 100).astype(_I64)
         c_release = (c_signed + c_adj) * c_unit
         c_adj_write = cancel_ok & (c_adj != 0)
-        pos_avail = pos_avail.at[lane_ids, aid].add(
-            jnp.where(c_adj_write, c_adj, 0))
+        pos_avail = _pa1(pos_avail, aid,
+                         cp_avail_raw + jnp.where(c_adj_write, c_adj, 0))
 
         # ------------------------------------------- balance delta merge
         delta = (jnp.where(transfer_ok, size64, 0)
@@ -381,6 +415,7 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
             "seq": seq, "book_exists": book_exists,
             "pos_amt": pos_amt, "pos_avail": pos_avail, "pos_used": pos_used,
             "bal": bal, "bal_used": bal_used, "err": err,
+            "fillbuf": st["fillbuf"], "filloff": st["filloff"],
         }
         outs = {
             "ok": ok,
@@ -398,6 +433,117 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         return jax.lax.scan(one_step, state, batch)
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# compact-I/O chunk: the serving-path wrapper around the scan
+
+
+def chunk_compaction(cfg: LaneConfig, T: int, M: int, step,
+                     dense_fills: bool = False):
+    """Wrap a (state, (T,S) batch) scan `step` with device-side input
+    scatter and output compaction.
+
+    Motivation: host<->device traffic, not FLOPs, bounds serving
+    throughput (the driver's TPU is reached through a tunnel measured at
+    ~10-20 MB/s with ~126 ms round trips; even on local PCIe the dense
+    (T,S,E) fill grids are >95% padding). Nothing O(T*S) crosses the
+    boundary: inputs arrive as (M,) message vectors with (t, lane)
+    schedule coordinates and are scattered to the grid on device, and
+    outputs return as per-message (M,) vectors. Fills are appended to
+    the PERSISTENT state fill log (state["fillbuf"], in cb order — the
+    session packs cb sorted by (t, lane) so the order is deterministic);
+    the host fetches the used prefix once per batch. Overflowing the log
+    sets the sticky LERR_FILLBUF_FULL error (H3 envelope knob
+    `fill_buffer`).
+
+    dense_fills=True instead returns per-message (M, E) fill arrays in
+    the outputs — the small-scale path used under shard_map test meshes,
+    where GSPMD owns data movement and transfer volume is irrelevant.
+
+    t >= T marks padding entries."""
+    S, E = cfg.lanes, cfg.max_fills
+    FB = cfg.fill_buffer
+
+    def chunk(state, cb):
+        valid = cb["t"] < T
+        flat = jnp.where(valid, cb["t"] * S + cb["lane"], T * S).astype(_I32)
+
+        def grid(v, dt):
+            z = jnp.zeros((T * S + 1,), dt)
+            return z.at[flat].set(v.astype(dt))[:T * S].reshape(T, S)
+
+        batch = {
+            "act": grid(cb["act"], _I32), "oid": grid(cb["oid"], _I64),
+            "aid": grid(cb["aid"], _I32), "price": grid(cb["price"], _I32),
+            "size": grid(cb["size"], _I32),
+        }
+        state, outs = step(state, batch)
+
+        gflat = jnp.minimum(flat, T * S - 1)
+
+        def pick(a):  # (T, S, ...) -> (M, ...) per-message gather
+            return a.reshape((T * S,) + a.shape[2:])[gflat]
+
+        nfill = jnp.where(valid, pick(outs["nfill"]), 0)
+        total = jnp.sum(nfill)
+        fo, fa = pick(outs["fill_oid"]), pick(outs["fill_aid"])
+        fp, fs = pick(outs["fill_price"]), pick(outs["fill_size"])
+
+        state = dict(state)
+        couts = {
+            "ok": jnp.where(valid, pick(outs["ok"]), False),
+            "residual": pick(outs["residual"]),
+            "append": jnp.where(valid, pick(outs["append"]), False),
+            "prev_oid": pick(outs["prev_oid"]),
+            "nfill": nfill,
+            "nfill_total": total,
+        }
+        if dense_fills:
+            couts["fill_oid"], couts["fill_aid"] = fo, fa
+            couts["fill_price"], couts["fill_size"] = fp, fs
+        else:
+            # append to the persistent fill log at the running offset
+            base = state["filloff"][0]
+            offs = base + (jnp.cumsum(nfill) - nfill).astype(_I64)
+            eidx = jnp.arange(E, dtype=_I64)[None, :]
+            mask = eidx < nfill[:, None].astype(_I64)
+            pos = jnp.where(mask, jnp.minimum(offs[:, None] + eidx, FB), FB)
+            pos = pos.astype(_I32).reshape(-1)
+            buf = state["fillbuf"]
+            for c, arr in enumerate((fo, fa, fp, fs)):
+                buf = buf.at[c].set(
+                    buf[c].at[pos].set(arr.astype(_I64).reshape(-1)))
+            new_off = base + total.astype(_I64)
+            err = state["err"]
+            err = jnp.where((err == LERR_OK) & (new_off > FB),
+                            jnp.asarray(LERR_FILLBUF_FULL, _I32), err)
+            state["fillbuf"] = buf
+            state["filloff"] = jnp.full((1,), 0, _I64) + new_off
+            state["err"] = err
+        couts["err"] = state["err"]
+        return state, couts
+
+    return chunk
+
+
+@functools.lru_cache(maxsize=None)
+def build_lane_chunk(cfg: LaneConfig, T: int, M: int):
+    """Single-device compact-I/O chunk fn, jitted with state donation and
+    cached per static shape — sessions share compiled executables."""
+    return jax.jit(chunk_compaction(cfg, T, M, build_lane_step(cfg)),
+                   donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def build_fill_reset(cfg: LaneConfig):
+    """Tiny jitted op: rewind the fill log (the host consumed it)."""
+    def reset(state):
+        state = dict(state)
+        state["filloff"] = jnp.zeros((1,), _I64)
+        return state
+
+    return jax.jit(reset, donate_argnums=(0,))
 
 
 # ---------------------------------------------------------------------------

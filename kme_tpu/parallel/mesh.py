@@ -53,7 +53,10 @@ def state_specs(state) -> dict:
     on the symbol axis, account/global arrays replicated."""
     specs = {}
     for k, v in state.items():
-        if k in ("bal", "bal_used", "err"):
+        if k in ("bal", "bal_used", "err", "fillbuf", "filloff"):
+            # fillbuf/filloff are written only on the single-device path
+            # (the sharded chunk uses dense per-message fills), so they
+            # stay zero and replicate trivially
             specs[k] = P()
         else:
             specs[k] = P(AXIS)
@@ -80,6 +83,24 @@ def build_sharded_step(cfg: L.LaneConfig, mesh: Mesh):
     }
     return _shard_map(inner, mesh, (st_specs, batch_specs),
                       (st_specs, out_specs))
+
+
+def build_sharded_chunk(cfg: L.LaneConfig, mesh: Mesh, T: int, M: int):
+    """Compact-I/O chunk (L.chunk_compaction) around the SHARDED scan:
+    the (M,) message vectors stay replicated, the grid scatter and output
+    compaction run under GSPMD (with_sharding_constraint pins the grids
+    to the symbol axis), and the scan itself is the shard_map step.
+    Fills return dense per-message (GSPMD moves them; transfer volume is
+    irrelevant at test-mesh scale)."""
+    sstep = build_sharded_step(cfg, mesh)
+    grid_sh = NamedSharding(mesh, P(None, AXIS))
+
+    def pinned_step(state, batch):
+        batch = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, grid_sh), batch)
+        return sstep(state, batch)
+
+    return L.chunk_compaction(cfg, T, M, pinned_step, dense_fills=True)
 
 
 def build_sharded_settle(cfg: L.LaneConfig, mesh: Mesh):
