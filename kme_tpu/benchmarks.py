@@ -26,12 +26,14 @@ REFERENCE_BASELINE_OPS = 5_000.0  # orders/sec, derived bound (BASELINE.md)
 
 def _assert_parity_prefix(msgs, cfg, shards, prefix: int) -> None:
     """Replay `prefix` messages through a throwaway session and the
-    scalar oracle; require byte-identical wire streams."""
+    scalar oracle (with the matching capacity envelope); require
+    byte-identical wire streams."""
     from kme_tpu.oracle import OracleEngine
     from kme_tpu.runtime.session import LaneSession
 
     ses = LaneSession(cfg, shards=shards)
-    ora = OracleEngine("fixed")
+    ora = OracleEngine("fixed", book_slots=cfg.slots,
+                       max_fills=cfg.max_fills)
     got = ses.process(msgs[:prefix])
     for i in range(prefix):
         want = [r.wire() for r in ora.process(msgs[i].copy())]
@@ -42,8 +44,9 @@ def _assert_parity_prefix(msgs, cfg, shards, prefix: int) -> None:
 def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
                       accounts: int = 2048, seed: int = 0,
                       zipf_a: float = 1.2, steps: int = 64,
-                      slots: int = 64, max_fills: int = 16,
-                      shards: int = 1, parity_prefix: int = 2000) -> dict:
+                      slots: int = 128, max_fills: int = 16,
+                      shards: int = 1, parity_prefix: int = 2000,
+                      profile_dir: str = None) -> dict:
     """End-to-end lane-engine throughput (see module docstring)."""
     import jax
 
@@ -57,36 +60,51 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
                               num_accounts=accounts, seed=seed,
                               zipf_a=zipf_a)
 
-    # correctness inside the bench: oracle parity on a stream prefix
-    _assert_parity_prefix(msgs, cfg, shards, min(parity_prefix, len(msgs)))
+    # correctness inside the bench: oracle parity on a stream prefix that
+    # extends past the preamble into the trade mix
+    preamble = 2 * accounts + symbols
+    prefix = min(preamble + parity_prefix, len(msgs))
+    _assert_parity_prefix(msgs, cfg, shards, prefix)
 
-    # warmup run on a fresh session: compiles every (T, M, F) bucket the
+    # warmup run on a fresh session: compiles every (T, M) bucket the
     # timed run will hit (compiled executables are shared via the
     # module-level chunk cache)
     LaneSession(cfg, shards=shards).process(msgs)
 
     # timed run, phase by phase (sum = the honest end-to-end number)
     ses = LaneSession(cfg, shards=shards)
-    t0 = time.perf_counter()
-    sched = ses.scheduler.plan(msgs)
-    t_plan = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+    try:
+        t0 = time.perf_counter()
+        sched = ses.scheduler.plan(msgs)
+        t_plan = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    seg_runs, barrier_ok = ses._dispatch(sched)   # pack + async dispatch
-    jax.block_until_ready(ses.state)
-    t_disp = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        runs, barrier_ok = ses._dispatch(sched)   # pack + async dispatch
+        jax.block_until_ready(ses.state)
+        t_disp = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    ses._fetch(seg_runs)
-    t_fetch = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        fills = ses._fetch(runs)
+        t_fetch = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    records = ses._reconstruct(msgs, sched, seg_runs, barrier_ok)
-    t_recon = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        records = ses._reconstruct(msgs, sched, runs, barrier_ok, fills)
+        t_recon = time.perf_counter() - t0
+    finally:
+        if profile_dir:
+            jax.profiler.stop_trace()
 
     n = len(msgs)
     total = t_plan + t_disp + t_fetch + t_recon
-    fills = sum(int(r.host["nfill_total"]) for r in seg_runs.values())
+    nfills = sum(int(r.host["nfill_total"]) for r in runs)
+    # slice to the real placements: the M bucket is padded and padding
+    # entries report ok=False
+    cap_rejects = sum(int(r.host["cap_reject"][:len(r.placements)].sum())
+                      for r in runs)
+    rejects = sum(int((~r.host["ok"][:len(r.placements)]).sum())
+                  for r in runs)
     n_records = sum(len(r) for r in records)
     steps_total = sum(sched.segment_steps)
     ops = n / total
@@ -97,15 +115,17 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
         "vs_baseline": round(ops / REFERENCE_BASELINE_OPS, 3),
         "detail": {
             "events": n, "symbols": symbols, "accounts": accounts,
-            "zipf_a": zipf_a, "shards": shards,
+            "zipf_a": zipf_a, "shards": shards, "slots": slots,
+            "max_fills": max_fills,
             "plan_s": round(t_plan, 3), "dispatch_s": round(t_disp, 3),
             "fetch_s": round(t_fetch, 3), "recon_s": round(t_recon, 3),
             "total_s": round(total, 3),
             "device_orders_per_sec": round(n / max(t_disp + t_fetch, 1e-9), 1),
             "sched_steps": steps_total,
             "msgs_per_step": round(n / max(steps_total, 1), 1),
-            "trades": fills, "out_records": n_records,
-            "parity_prefix": parity_prefix,
+            "trades": nfills, "out_records": n_records,
+            "cap_rejects": cap_rejects, "rejects": rejects,
+            "parity_checked_msgs": prefix,
             "backend": jax.devices()[0].platform,
             "baseline_assumption_ops": REFERENCE_BASELINE_OPS,
         },
@@ -154,6 +174,16 @@ def main(argv=None) -> int:
     p.add_argument("--accounts", type=int, default=2048)
     p.add_argument("--zipf", type=float, default=1.2)
     p.add_argument("--shards", type=int, default=1)
+    p.add_argument("--slots", type=int, default=128,
+                   help="resting-order slots per book side (H2 envelope)")
+    p.add_argument("--max-fills", type=int, default=16,
+                   help="makers swept per taker (H3 envelope)")
+    p.add_argument("--steps", type=int, default=64,
+                   help="scan-length bucket granularity of dispatch windows")
+    p.add_argument("--parity-prefix", type=int, default=2000,
+                   help="post-preamble messages checked against the oracle")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="dump a jax.profiler trace of the timed run to DIR")
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--compat", choices=("java", "fixed"), default="java")
@@ -161,7 +191,10 @@ def main(argv=None) -> int:
     if args.suite == "lanes":
         rec = bench_lane_engine(args.events or 100_000, args.symbols,
                                 args.accounts, args.seed, args.zipf,
-                                shards=args.shards)
+                                steps=args.steps, slots=args.slots,
+                                max_fills=args.max_fills, shards=args.shards,
+                                parity_prefix=args.parity_prefix,
+                                profile_dir=args.profile)
     else:
         rec = bench_parity_engine(args.events or 4096, args.seed, args.batch,
                                   args.compat)

@@ -105,11 +105,22 @@ def main(argv=None) -> int:
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision"))
     args, rest = p.parse_known_args(argv)
-    return {
-        "loadgen": loadgen_main, "oracle": oracle_main, "bench": bench_main,
-        "serve": serve_main, "consume": consume_main,
-        "provision": provision_main,
-    }[args.command](rest)
+    try:
+        return {
+            "loadgen": loadgen_main, "oracle": oracle_main,
+            "bench": bench_main, "serve": serve_main,
+            "consume": consume_main, "provision": provision_main,
+        }[args.command](rest)
+    except BrokenPipeError:
+        # downstream closed the pipe (e.g. `| head`) — the Unix-polite
+        # exit; point both std streams at devnull so interpreter-shutdown
+        # flushes can't re-raise on the broken descriptors
+        import os
+
+        fd = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(fd, sys.stdout.fileno())
+        os.dup2(fd, sys.stderr.fileno())
+        return 0
 
 
 if __name__ == "__main__":

@@ -66,11 +66,14 @@ L_CREATE = 4
 L_TRANSFER = 5
 L_ADD_SYMBOL = 6
 
-# lane error codes (sticky, per batch)
+# lane error codes (sticky, per batch). Book/fill CAPACITY overflow is
+# NOT an error: it is a per-message REJECT (the H2/H3 envelope policy —
+# the offending order is refused as a unit, surfaced as an OUT REJECT in
+# the wire stream, and the batch continues). Only the host-side fill-log
+# sizing knob remains a sticky error, since it is a session buffer bound,
+# not an engine-semantics bound.
 LERR_OK = 0
-LERR_BOOK_FULL = 1    # resting-slot capacity exhausted (H2 envelope)
-LERR_FILLS_FULL = 2   # sweep crossed more than max_fills makers (H3)
-LERR_FILLBUF_FULL = 3  # chunk fill buffer exhausted (fills_per_msg knob)
+LERR_FILLBUF_FULL = 3  # session fill log exhausted (fill_buffer knob)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -197,11 +200,6 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         unit = jnp.where(is_buy, price, price - 100).astype(_I64)
         risk = (signed64 + adj) * unit
         trade_ok = is_trade & valid & st["book_exists"] & bal_ok & ~(bal_g < risk)
-        # margin netting blocks part of the opposite position (:179)
-        adj_write = trade_ok & (adj != 0)
-        pos_avail = _pa1(st["pos_avail"], aid,
-                         _ta1(st["pos_avail"], aid)
-                         + jnp.where(adj_write, -adj, 0))
 
         # -------------------------------------------------- TRADE: sweep
         # the match loop (KProcessor.java:237-258) as one masked argsort +
@@ -227,21 +225,52 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         nfill = jnp.sum(fill_sorted > 0, axis=1).astype(_I32)
         overflow_fills = nfill > E
 
+        # ------------------------- capacity envelope (SURVEY.md §7 H2/H3)
+        # A message that would overflow its book side (no free resting
+        # slot for the residual) or sweep more makers than max_fills is
+        # rejected AS A UNIT — no fills, no state change, OUT REJECT on
+        # the wire — mirrored exactly by the oracle's capacity envelope.
+        # Per-message policy; the batch continues (no sticky poison).
+        side_is0 = (side == 0)[:, None]
+        own = lambda a: pick_side(a, side_is0)
+        o_used_pre = own(st["slot_used"])
+        free_idx = jnp.argmax(~o_used_pre, axis=1).astype(_I32)
+        have_free = jnp.any(~o_used_pre, axis=1)
+        rest_want = trade_ok & (residual > 0)
+        overflow_book = rest_want & ~have_free
+        cap_reject = trade_ok & (overflow_book | overflow_fills)
+        trade_acc = trade_ok & ~cap_reject
+
+        # margin netting blocks part of the opposite position (:179) —
+        # applied only for accepted messages
+        adj_write = trade_acc & (adj != 0)
+        pos_avail = _pa1(st["pos_avail"], aid,
+                         _ta1(st["pos_avail"], aid)
+                         + jnp.where(adj_write, -adj, 0))
+
         # write back maker sizes via the inverse permutation
         inv = jnp.argsort(order, axis=1)
         fill_slot = jnp.take_along_axis(fill_sorted, inv, axis=1)
         new_m_size = (m_size - fill_slot).astype(_I32)
         new_m_used = m_used & (new_m_size > 0)
         slot_size = set_side(st["slot_size"], opp_oh,
-                             jnp.where(trade_ok[:, None], new_m_size, m_size))
+                             jnp.where(trade_acc[:, None], new_m_size, m_size))
         slot_used = set_side(st["slot_used"], opp_oh,
-                             jnp.where(trade_ok[:, None], new_m_used, m_used))
+                             jnp.where(trade_acc[:, None], new_m_used, m_used))
 
-        # compact per-trade outputs (priority order), truncated at E
-        fo_oid = take(m_oid)[:, :E]
-        fo_aid = take(m_aid)[:, :E]
-        fo_price = take(m_price)[:, :E]
-        fo_fill = fill_sorted[:, :E].astype(_I32)
+        # compact per-trade outputs (priority order), truncated at E.
+        # E > N is legal (a sweep can cross at most N makers): the [:E]
+        # slice clamps at N, so pad the tail back out to E.
+        def cap_e(a):
+            a = a[:, :E]
+            if a.shape[1] < E:
+                a = jnp.pad(a, ((0, 0), (0, E - a.shape[1])))
+            return a
+
+        fo_oid = cap_e(take(m_oid))
+        fo_aid = cap_e(take(m_aid))
+        fo_price = cap_e(take(m_price))
+        fo_fill = cap_e(fill_sorted).astype(_I32)
 
         # ---------------------------------- TRADE: position updates
         # Exact closed-form replay of the per-trade fill sequence (maker
@@ -267,7 +296,7 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         t_sgn = jnp.where(is_buy[:, None], fo_fill, -fo_fill).astype(_I64)
         sgn = jnp.zeros((S, twoE), _I64).at[:, 0::2].set(m_sgn)
         sgn = sgn.at[:, 1::2].set(t_sgn)
-        fv = (fo_fill > 0) & trade_ok[:, None]
+        fv = (fo_fill > 0) & trade_acc[:, None]
         fvalid = jnp.zeros((S, twoE), bool).at[:, 0::2].set(fv)
         fvalid = fvalid.at[:, 1::2].set(fv)
         pu_acc = jnp.take_along_axis(st["pos_used"], acc, axis=1)
@@ -308,30 +337,24 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         # size * 0 == 0 — the structural fact the scheduler relies on).
         # Each per-fill product is Java int*int — wraps at int32 BEFORE
         # the long balance add (KProcessor.java:286, oracle._fill_order)
-        improve = (jnp.where(trade_ok[:, None], price[:, None], 0)
+        improve = (jnp.where(trade_acc[:, None], price[:, None], 0)
                    - fo_price).astype(_I32)
         signed_credit = jnp.where(is_buy[:, None], fo_fill, -fo_fill).astype(_I32)
         credit = jnp.sum((signed_credit * improve).astype(_I64), axis=1)
 
         # ------------------------------------------------- TRADE: rest
-        rest = trade_ok & (residual > 0)
-        side_is0 = (side == 0)[:, None]
-        own = lambda a: pick_side(a, side_is0)
-        o_used = own(slot_used)  # after maker updates (opp side untouched)
-        free_idx = jnp.argmax(~o_used, axis=1).astype(_I32)
-        have_free = jnp.any(~o_used, axis=1)
-        overflow_book = rest & ~have_free
+        # (free slot existence already established by the capacity
+        # envelope: trade_acc & rest_want implies have_free)
         # Q9 prev-echo: tail of my price bucket = max seqno among used
         # same-price slots on my side
         o_price, o_seq_ = own(st["slot_price"]), own(st["slot_seq"])
-        o_oid_arr, o_used0 = own(st["slot_oid"]), own(st["slot_used"])
-        same_level = o_used0 & (o_price == price[:, None])
+        same_level = o_used_pre & (o_price == price[:, None])
         bucket_nonempty = jnp.any(same_level, axis=1)
         tail_idx = jnp.argmax(
             jnp.where(same_level, o_seq_, -1), axis=1).astype(_I32)
-        tail_oid = _ta1(o_oid_arr, tail_idx)
+        tail_oid = _ta1(own(st["slot_oid"]), tail_idx)
 
-        do_rest = rest & have_free
+        do_rest = rest_want & trade_acc
         seqno = st["seq"]
         # one-hot write of the rested order into (lane, side, free_idx)
         slot_oh = (free_idx[:, None] == jnp.arange(N, dtype=_I32))[:, None, :]
@@ -379,7 +402,7 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
 
         # ------------------------------------------- balance delta merge
         delta = (jnp.where(transfer_ok, size64, 0)
-                 + jnp.where(trade_ok, -risk + credit, 0)
+                 + jnp.where(trade_acc, -risk + credit, 0)
                  + jnp.where(cancel_ok, c_release, 0))
         dense_delta = jnp.zeros((A,), _I64).at[aid].add(delta)
         dense_create = jnp.zeros((A,), bool).at[aid].max(create_ok)
@@ -391,17 +414,13 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         bal_used = st["bal_used"] | dense_create
 
         err = st["err"]
-        err = jnp.where((err == LERR_OK) & jnp.any(overflow_book),
-                        jnp.asarray(LERR_BOOK_FULL, _I32), err)
-        err = jnp.where((err == LERR_OK) & jnp.any(overflow_fills & trade_ok),
-                        jnp.asarray(LERR_FILLS_FULL, _I32), err)
         if axis_name is not None:
-            # any shard's envelope error becomes globally visible (and the
+            # any shard's sticky error becomes globally visible (and the
             # replicated err stays identical across shards)
             err = jax.lax.pmax(err, axis_name)
 
         ok = jnp.where(
-            is_trade, trade_ok,
+            is_trade, trade_acc,
             jnp.where(is_cancel, cancel_ok,
                       jnp.where(act == L_CREATE, create_ok,
                                 jnp.where(act == L_TRANSFER, transfer_ok,
@@ -419,10 +438,11 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         }
         outs = {
             "ok": ok,
-            "residual": jnp.where(trade_ok, residual, size).astype(_I32),
+            "residual": jnp.where(trade_acc, residual, size).astype(_I32),
             "append": bucket_nonempty & do_rest,
             "prev_oid": tail_oid,
-            "nfill": jnp.where(trade_ok, jnp.minimum(nfill, E), 0),
+            "nfill": jnp.where(trade_acc, nfill, 0),
+            "cap_reject": cap_reject,
             "fill_oid": fo_oid, "fill_aid": fo_aid,
             "fill_price": fo_price, "fill_size": fo_fill,
             "err": err,
@@ -496,6 +516,7 @@ def chunk_compaction(cfg: LaneConfig, T: int, M: int, step,
             "residual": pick(outs["residual"]),
             "append": jnp.where(valid, pick(outs["append"]), False),
             "prev_oid": pick(outs["prev_oid"]),
+            "cap_reject": jnp.where(valid, pick(outs["cap_reject"]), False),
             "nfill": nfill,
             "nfill_total": total,
         }

@@ -132,10 +132,24 @@ class OracleEngine:
     records in forward order: IN echo, fill events, OUT echo
     (KProcessor.java:97, 272-273, 124)."""
 
-    def __init__(self, compat: str = "java") -> None:
+    def __init__(self, compat: str = "java",
+                 book_slots: Optional[int] = None,
+                 max_fills: Optional[int] = None) -> None:
+        """book_slots / max_fills: the CAPACITY ENVELOPE mirroring the
+        lane engine's static shapes (engine/lanes.py LaneConfig slots /
+        max_fills). When set (fixed mode only), a BUY/SELL that would
+        rest beyond `book_slots` resting orders on its (sid, side) or
+        sweep more than `max_fills` makers is rejected as a unit — no
+        fills, no state change, OUT REJECT — exactly the device engine's
+        per-message H2/H3 overflow policy. None = unbounded (the
+        reference's own linked lists are unbounded)."""
         if compat not in ("java", "fixed"):
             raise ValueError(compat)
         self.java = compat == "java"
+        if self.java and (book_slots is not None or max_fills is not None):
+            raise ValueError("capacity envelope is a fixed-mode concept")
+        self.book_slots = book_slots
+        self.max_fills = max_fills
         # The five stores (KProcessor.java:30-49). Book/bucket keys follow
         # the reference's signed-sid codec in java mode; fixed mode uses
         # explicit side-tagged keys (2*sid + side), removing Q1.
@@ -170,7 +184,43 @@ class OracleEngine:
     # public entry
 
     def process(self, msg: OrderMsg) -> List[OutRecord]:
-        """Replicates MatchingEngine.process (KProcessor.java:95-126)."""
+        """Replicates MatchingEngine.process (KProcessor.java:95-126),
+        optionally under the capacity envelope (see __init__)."""
+        envelope = (self.book_slots is not None or self.max_fills is not None)
+        if envelope and msg.action in (op.BUY, op.SELL):
+            return self._process_enveloped(msg)
+        return self._process_inner(msg)
+
+    def _process_enveloped(self, msg: OrderMsg) -> List[OutRecord]:
+        """Run a trade message, then roll the whole message back into an
+        OUT REJECT if it violated the capacity envelope. Store values are
+        immutable (tuples / copied records), so shallow dict snapshots
+        are exact."""
+        orig = msg.copy()
+        snap = (dict(self.balances), dict(self.positions), dict(self.orders),
+                dict(self.books), dict(self.buckets))
+        out = self._process_inner(msg)
+        violated = False
+        if self.max_fills is not None:
+            # OUT records = 2 per executed trade + 1 result echo
+            ntrades = (sum(1 for r in out if r.key == "OUT") - 1) // 2
+            violated = ntrades > self.max_fills
+        if not violated and self.book_slots is not None:
+            rested = self.orders.get(orig.oid)
+            if rested is not None and rested.sid == orig.sid \
+                    and rested.action == orig.action:
+                n_side = sum(1 for r in self.orders.values()
+                             if r.sid == orig.sid and r.action == orig.action)
+                violated = n_side > self.book_slots
+        if not violated:
+            return out
+        (self.balances, self.positions, self.orders,
+         self.books, self.buckets) = snap
+        rej = orig.copy()
+        rej.action = op.REJECT
+        return [OutRecord("IN", orig.copy()), OutRecord("OUT", rej)]
+
+    def _process_inner(self, msg: OrderMsg) -> List[OutRecord]:
         order = msg.copy()
         self._out = [OutRecord("IN", order.copy())]
         result = False

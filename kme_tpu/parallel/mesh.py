@@ -77,7 +77,8 @@ def build_sharded_step(cfg: L.LaneConfig, mesh: Mesh):
     out_specs = {
         "ok": P(None, AXIS), "residual": P(None, AXIS),
         "append": P(None, AXIS), "prev_oid": P(None, AXIS),
-        "nfill": P(None, AXIS), "fill_oid": P(None, AXIS),
+        "nfill": P(None, AXIS), "cap_reject": P(None, AXIS),
+        "fill_oid": P(None, AXIS),
         "fill_aid": P(None, AXIS), "fill_price": P(None, AXIS),
         "fill_size": P(None, AXIS), "err": P(),
     }

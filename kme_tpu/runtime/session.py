@@ -32,9 +32,7 @@ from kme_tpu.runtime.sequencer import Schedule, Scheduler
 from kme_tpu.wire import OrderMsg, OutRecord
 
 _LERR_NAMES = {
-    L.LERR_BOOK_FULL: "book slot capacity exhausted",
-    L.LERR_FILLS_FULL: "sweep crossed more makers than max_fills",
-    L.LERR_FILLBUF_FULL: "segment fill buffer exhausted (fills_per_msg)",
+    L.LERR_FILLBUF_FULL: "session fill log exhausted (fill_buffer knob)",
 }
 
 

@@ -12,7 +12,7 @@ import kme_tpu.opcodes as op
 from kme_tpu.engine.lanes import LaneConfig
 from kme_tpu.oracle import OracleEngine
 from kme_tpu.runtime.sequencer import CapacityError, EnvelopeError, Scheduler
-from kme_tpu.runtime.session import LaneEngineError, LaneSession
+from kme_tpu.runtime.session import LaneSession
 from kme_tpu.wire import OrderMsg
 from kme_tpu.workload import cancel_heavy_stream, harness_stream, zipf_symbol_stream
 
@@ -141,7 +141,10 @@ def test_capacity_and_envelope_errors():
                             size=1)])
 
 
-def test_lane_slot_overflow_flagged():
+def test_lane_slot_overflow_rejects_per_message():
+    """H2 envelope policy: the 5th non-crossing buy into a 4-slot book is
+    rejected as a unit (OUT REJECT); the batch continues, no exception.
+    Byte-exact vs the enveloped oracle."""
     cfg = LaneConfig(lanes=2, slots=4, accounts=8, max_fills=4, steps=8)
     ses = LaneSession(cfg)
     msgs = [OrderMsg(action=op.CREATE_BALANCE, aid=1),
@@ -149,8 +152,13 @@ def test_lane_slot_overflow_flagged():
             OrderMsg(action=op.ADD_SYMBOL, sid=0)]
     msgs += [OrderMsg(action=op.BUY, oid=10 + i, aid=1, sid=0, price=10 + i,
                       size=1) for i in range(5)]
-    with pytest.raises(LaneEngineError):
-        ses.process(msgs)
+    ora = OracleEngine("fixed", book_slots=4, max_fills=4)
+    want = [[r.wire() for r in ora.process(m.copy())] for m in msgs]
+    got = [[r.wire() for r in recs] for recs in ses.process(msgs)]
+    assert got == want
+    assert got[-1][-1].startswith('OUT {"action":7')  # the overflow reject
+    assert sum(1 for recs in got for ln in recs
+               if ln.startswith('OUT {"action":7')) == 1
 
 
 def test_lane_fill_credit_wraps_at_int32():
