@@ -87,6 +87,17 @@ class LaneConfig:
     steps: int = 64           # T bucket granularity of a dispatch window
     window: int = 1024        # max scan steps per dispatch (HBM bound)
     fill_buffer: int = 1 << 20  # device fill ring capacity (H3 envelope)
+    # width > 0 enables ACTIVE-LANE COMPACTION: each scan step computes
+    # at width W (the at-most-W lanes the scheduler placed in the step)
+    # instead of full S — book rows are gathered/scattered by lane id
+    # and position ops use flat lane*A+acc indices, so per-step work is
+    # O(W·N + W·E) instead of O(S·(N+A)). Profiled on v5e: the full-
+    # width step spends >85% of its time on (S,2E)->(S,A) scatters and
+    # (S,N) gathers for lanes that are pure padding. The LAST device
+    # lane is reserved as the padding scrap row (LaneSession sizes the
+    # device state to lanes+1). Single-device only; the sharded path
+    # ignores width.
+    width: int = 0            # W — max active lanes per scan step
 
 
 def make_lane_state(cfg: LaneConfig):
@@ -122,17 +133,28 @@ def _priority_key(side, price, seqno):
     return (p << 32) | seqno.astype(_I64)
 
 
+_ROW_KEYS = ("slot_oid", "slot_aid", "slot_price", "slot_size",
+             "slot_seq", "slot_used")
+
+
 @functools.lru_cache(maxsize=None)
 def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
     """The pure scan-step batch function: (state, batch) -> (state, outs).
 
-    batch: dict of (T, S) arrays (act, oid, aid, price, size).
-    outs per (t, lane): ok, residual, append prev info, fill arrays,
+    batch: dict of (T, X) arrays (act, oid, aid, price, size) where X is
+    the step width — S in full-width mode, cfg.width under active-lane
+    compaction, which adds a (T, X) "lane" array mapping each step slot
+    to its device lane (padding slots carry the scrap lane S-1 with
+    act=NOP, so their writes are identity by construction).
+    outs per (t, slot): ok, residual, append prev info, fill arrays,
     plus the sticky error code.
     When axis_name is set the balance-delta merge is psum'd over that
-    mesh axis (shard_map embedding)."""
+    mesh axis (shard_map embedding; full-width only)."""
     S, N, A, E = cfg.lanes, cfg.slots, cfg.accounts, cfg.max_fills
-    lane_ids = jnp.arange(S, dtype=_I32)
+    compact = cfg.width > 0
+    X = cfg.width if compact else S
+    assert not (compact and axis_name), \
+        "active-lane compaction is single-device only"
 
     # TPU-friendly indexed access: multi-dim advanced indexing like
     # a[lane_ids, side, idx] lowers to a generic (slow, ~ms) gather /
@@ -140,11 +162,11 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
     # work (~20µs at S=1024). Measured on v5e — use ONLY these forms in
     # the per-step path.
     def _ta1(a, idx):
-        """a: (S, K), idx: (S,) -> (S,) — batched axis-1 gather."""
+        """a: (X, K), idx: (X,) -> (X,) — batched axis-1 gather."""
         return jnp.take_along_axis(a, idx[:, None].astype(_I32), axis=1)[:, 0]
 
     def _pa1(a, idx, vals):
-        """a: (S, K), idx: (S,) -> a with a[s, idx[s]] = vals[s]."""
+        """a: (X, K), idx: (X,) -> a with a[x, idx[x]] = vals[x]."""
         return jnp.put_along_axis(a, idx[:, None].astype(_I32),
                                   vals[:, None].astype(a.dtype), axis=1,
                                   inplace=False)
@@ -153,11 +175,48 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         act, oid, aid = msg["act"], msg["oid"], msg["aid"]
         price, size = msg["price"], msg["size"]
 
+        if compact:
+            lanes = msg["lane"].astype(_I32)        # (W,) device lanes
+            sl = {k: st[k][lanes] for k in _ROW_KEYS}   # (W, 2, N) rows
+            seq_v = st["seq"][lanes]
+            be_v = st["book_exists"][lanes]
+            # positions via flat lane*A+acc indices on the (S*A,) view:
+            # the update count drops from S*2E to W*2E scalar scatters
+            pbase = lanes * A                       # (W,) int32; S*A < 2^31
+            pa_f = st["pos_amt"].reshape(-1)
+            pv_f = st["pos_avail"].reshape(-1)
+            pu_f = st["pos_used"].reshape(-1)
+
+            def pos_read(arr_f, accs):              # accs: (W,) | (W, K)
+                idx = pbase[:, None] + accs if accs.ndim == 2 else pbase + accs
+                return arr_f[idx]
+
+            def pos_write(arr_f, accs, vals):
+                idx = pbase[:, None] + accs if accs.ndim == 2 else pbase + accs
+                return arr_f.at[idx].set(vals.astype(arr_f.dtype))
+        else:
+            sl = {k: st[k] for k in _ROW_KEYS}
+            seq_v = st["seq"]
+            be_v = st["book_exists"]
+            pa_f, pv_f, pu_f = st["pos_amt"], st["pos_avail"], st["pos_used"]
+
+            def pos_read(arr, accs):
+                if accs.ndim == 2:
+                    return jnp.take_along_axis(arr, accs, axis=1)
+                return _ta1(arr, accs)
+
+            def pos_write(arr, accs, vals):
+                if accs.ndim == 2:
+                    return jnp.put_along_axis(arr, accs,
+                                              vals.astype(arr.dtype),
+                                              axis=1, inplace=False)
+                return _pa1(arr, accs, vals)
+
         is_trade = (act == L_BUY) | (act == L_SELL)
         is_buy = act == L_BUY
         side = jnp.where(is_buy, 0, 1).astype(_I32)     # own (rest) side
         opp = (1 - side).astype(_I32)
-        opp_is0 = (opp == 0)[:, None]                   # (S, 1) side select
+        opp_is0 = (opp == 0)[:, None]                   # (X, 1) side select
         side_oh = (side[:, None] == jnp.arange(2, dtype=_I32))[:, :, None]
         opp_oh = (opp[:, None] == jnp.arange(2, dtype=_I32))[:, :, None]
 
@@ -165,10 +224,10 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
             return jnp.where(is0, a[:, 0], a[:, 1])
 
         def set_side(a, oh, new):
-            """a: (S,2,N); oh: (S,2,1) one-hot; new: (S,N) side image."""
+            """a: (X,2,N); oh: (X,2,1) one-hot; new: (X,N) side image."""
             return jnp.where(oh, new[:, None, :], a)
 
-        bal_g = st["bal"][aid]              # (S,) pre-step actor balances
+        bal_g = st["bal"][aid]              # (X,) pre-step actor balances
         bal_ok = st["bal_used"][aid]
 
         # ------------------------------------------------- CREATE_BALANCE
@@ -182,8 +241,8 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         transfer_ok = (act == L_TRANSFER) & bal_ok & ~(bal_g < neg_size64)
 
         # ----------------------------------------------------- ADD_SYMBOL
-        addsym_ok = (act == L_ADD_SYMBOL) & ~st["book_exists"]
-        book_exists = st["book_exists"] | addsym_ok
+        addsym_ok = (act == L_ADD_SYMBOL) & ~be_v
+        book_exists = be_v | addsym_ok
 
         # ------------------------------------------------- TRADE: margin
         # checkBalance (KProcessor.java:167-182), fixed-domain: price in
@@ -191,32 +250,37 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         valid = (price >= 0) & (price < 126) & (size > 0)
         signed = jnp.where(is_buy, size, -size).astype(_I32)
         signed64 = signed.astype(_I64)
-        p_amt = _ta1(st["pos_amt"], aid)
-        p_avail = jnp.where(_ta1(st["pos_used"], aid),
-                            _ta1(st["pos_avail"], aid), 0)
+        p_avail = jnp.where(pos_read(pu_f, aid),
+                            pos_read(pv_f, aid), 0)
         adj = jnp.where(is_buy,
                         jnp.maximum(jnp.minimum(p_avail, 0), -signed64),
                         jnp.minimum(jnp.maximum(p_avail, 0), -signed64))
         unit = jnp.where(is_buy, price, price - 100).astype(_I64)
         risk = (signed64 + adj) * unit
-        trade_ok = is_trade & valid & st["book_exists"] & bal_ok & ~(bal_g < risk)
+        trade_ok = is_trade & valid & be_v & bal_ok & ~(bal_g < risk)
 
         # -------------------------------------------------- TRADE: sweep
-        # the match loop (KProcessor.java:237-258) as one masked argsort +
-        # prefix sum over the opposite side's slots
-        g = lambda a: pick_side(a, opp_is0)            # (S, N) opp side
-        m_used = g(st["slot_used"])
-        m_price, m_size = g(st["slot_price"]), g(st["slot_size"])
-        m_oid, m_aid, m_seq = g(st["slot_oid"]), g(st["slot_aid"]), g(st["slot_seq"])
+        # the match loop (KProcessor.java:237-258) as ONE multi-operand
+        # lax.sort + prefix sum over the opposite side's slots. Profiled
+        # on v5e: the sort network is ~30us at (1024, 128) while argsort
+        # + per-payload take_along gathers cost ~9ms/step — payloads must
+        # ride the sort, and the inverse permutation is a second sort
+        # keyed on the slot index, never a gather.
+        g = lambda a: pick_side(a, opp_is0)            # (X, N) opp side
+        m_used = g(sl["slot_used"])
+        m_price, m_size = g(sl["slot_price"]), g(sl["slot_size"])
+        m_oid, m_aid, m_seq = g(sl["slot_oid"]), g(sl["slot_aid"]), g(sl["slot_seq"])
         crossing = m_used & jnp.where(
             is_buy[:, None], m_price <= price[:, None], m_price >= price[:, None])
         crossing = crossing & trade_ok[:, None]
         key = _priority_key(opp[:, None], m_price, m_seq)
         BIG = jnp.asarray((1 << 62), _I64)
         masked_key = jnp.where(crossing, key, BIG)
-        order = jnp.argsort(masked_key, axis=1)        # (S, N) best-first
-        take = lambda a: jnp.take_along_axis(a, order, axis=1)
-        sz_sorted = jnp.where(take(crossing), take(m_size), 0)
+        slot_ids = jnp.broadcast_to(jnp.arange(N, dtype=_I32), (X, N))
+        (_, cross_s, sz_raw_s, oid_s, aid_s, price_s, slot_s) = jax.lax.sort(
+            (masked_key, crossing, m_size, m_oid, m_aid, m_price, slot_ids),
+            num_keys=1, dimension=1)                   # (X, N) best-first
+        sz_sorted = jnp.where(cross_s, sz_raw_s, 0)
         prefix = jnp.cumsum(sz_sorted, axis=1) - sz_sorted   # exclusive
         z = jnp.where(trade_ok, size, 0)[:, None]
         fill_sorted = jnp.clip(z - prefix, 0, sz_sorted)
@@ -233,7 +297,7 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         # Per-message policy; the batch continues (no sticky poison).
         side_is0 = (side == 0)[:, None]
         own = lambda a: pick_side(a, side_is0)
-        o_used_pre = own(st["slot_used"])
+        o_used_pre = own(sl["slot_used"])
         free_idx = jnp.argmax(~o_used_pre, axis=1).astype(_I32)
         have_free = jnp.any(~o_used_pre, axis=1)
         rest_want = trade_ok & (residual > 0)
@@ -244,18 +308,21 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         # margin netting blocks part of the opposite position (:179) —
         # applied only for accepted messages
         adj_write = trade_acc & (adj != 0)
-        pos_avail = _pa1(st["pos_avail"], aid,
-                         _ta1(st["pos_avail"], aid)
+        pv_f = pos_write(pv_f, aid,
+                         pos_read(pv_f, aid)
                          + jnp.where(adj_write, -adj, 0))
 
-        # write back maker sizes via the inverse permutation
-        inv = jnp.argsort(order, axis=1)
-        fill_slot = jnp.take_along_axis(fill_sorted, inv, axis=1)
-        new_m_size = (m_size - fill_slot).astype(_I32)
+        # write back maker sizes via the inverse permutation: a second
+        # sort keyed on the carried slot index (slot_s is a permutation
+        # of 0..N-1 per lane, so this restores slot order exactly)
+        _, new_sz_s = jax.lax.sort(
+            (slot_s, (sz_raw_s - fill_sorted).astype(_I32)),
+            num_keys=1, dimension=1)
+        new_m_size = new_sz_s
         new_m_used = m_used & (new_m_size > 0)
-        slot_size = set_side(st["slot_size"], opp_oh,
+        slot_size = set_side(sl["slot_size"], opp_oh,
                              jnp.where(trade_acc[:, None], new_m_size, m_size))
-        slot_used = set_side(st["slot_used"], opp_oh,
+        slot_used = set_side(sl["slot_used"], opp_oh,
                              jnp.where(trade_acc[:, None], new_m_used, m_used))
 
         # compact per-trade outputs (priority order), truncated at E.
@@ -267,9 +334,9 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
                 a = jnp.pad(a, ((0, 0), (0, E - a.shape[1])))
             return a
 
-        fo_oid = cap_e(take(m_oid))
-        fo_aid = cap_e(take(m_aid))
-        fo_price = cap_e(take(m_price))
+        fo_oid = cap_e(oid_s)
+        fo_aid = cap_e(aid_s)
+        fo_price = cap_e(price_s)
         fo_fill = cap_e(fill_sorted).astype(_I32)
 
         # ---------------------------------- TRADE: position updates
@@ -289,21 +356,28 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         # XLA:TPU's unimplemented X64-rewrite path and fails to compile.)
         twoE = 2 * E
         idx2 = jnp.arange(twoE, dtype=_I32)
-        acc = jnp.zeros((S, twoE), _I32)
+        acc = jnp.zeros((X, twoE), _I32)
         acc = acc.at[:, 0::2].set(fo_aid).at[:, 1::2].set(
-            jnp.broadcast_to(aid[:, None], (S, E)))
+            jnp.broadcast_to(aid[:, None], (X, E)))
         m_sgn = jnp.where(is_buy[:, None], -fo_fill, fo_fill).astype(_I64)
         t_sgn = jnp.where(is_buy[:, None], fo_fill, -fo_fill).astype(_I64)
-        sgn = jnp.zeros((S, twoE), _I64).at[:, 0::2].set(m_sgn)
+        sgn = jnp.zeros((X, twoE), _I64).at[:, 0::2].set(m_sgn)
         sgn = sgn.at[:, 1::2].set(t_sgn)
         fv = (fo_fill > 0) & trade_acc[:, None]
-        fvalid = jnp.zeros((S, twoE), bool).at[:, 0::2].set(fv)
+        fvalid = jnp.zeros((X, twoE), bool).at[:, 0::2].set(fv)
         fvalid = fvalid.at[:, 1::2].set(fv)
-        pu_acc = jnp.take_along_axis(st["pos_used"], acc, axis=1)
-        a0 = jnp.where(pu_acc, jnp.take_along_axis(st["pos_amt"], acc, axis=1), 0)
-        v0 = jnp.where(pu_acc, jnp.take_along_axis(pos_avail, acc, axis=1), 0)
+        pu_acc = pos_read(pu_f, acc)
+        a0 = jnp.where(pu_acc, pos_read(pa_f, acc), 0)
+        v0 = jnp.where(pu_acc, pos_read(pv_f, acc), 0)
+        # eq[s, i, j]: entry i is a VALID contributor to entry j's account.
+        # Only the contributor side is validity-gated: every entry j —
+        # valid or not — then computes its account's exact final value, so
+        # ALL duplicate scatter targets carry identical values and the
+        # plain put_along below is deterministic with no dummy column.
+        # (Profiled: the old pad-concat + slice around a (S, A+1) scatter
+        # copied the 16MB position arrays twice and cost ~2ms per call.)
         eq = ((acc[:, :, None] == acc[:, None, :])
-              & fvalid[:, :, None] & fvalid[:, None, :])     # (S, i, j)
+              & fvalid[:, :, None])                          # (S, i, j)
         le = idx2[:, None] <= idx2[None, :]
         sgn_b = sgn[:, :, None]                              # (S, i, 1)
         prefix = a0 + jnp.sum(jnp.where(eq & le[None], sgn_b, 0), axis=1)
@@ -318,20 +392,11 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         amt_fin = a0 + total
         avail_fin = jnp.where(anyzero, avail_sum, v0 + total)
         used_fin = amt_fin != 0
-        # scatter with a dummy column for invalid entries; duplicate
-        # indices carry identical values, so the scatter is deterministic
-        acc_t = jnp.where(fvalid, acc, A)
-
-        def _scat(arr, vals):
-            pad = jnp.concatenate(
-                [arr, jnp.zeros((S, 1), arr.dtype)], axis=1)
-            pad = jnp.put_along_axis(pad, acc_t, vals.astype(arr.dtype),
-                                     axis=1, inplace=False)
-            return pad[:, :A]
-
-        pos_amt = _scat(st["pos_amt"], jnp.where(used_fin, amt_fin, 0))
-        pos_avail = _scat(pos_avail, jnp.where(used_fin, avail_fin, 0))
-        pos_used = _scat(st["pos_used"], used_fin)
+        # untouched accounts land on identity writes (amt_fin = a0 etc.),
+        # so no masking is needed: scatter values directly
+        pa_f = pos_write(pa_f, acc, jnp.where(used_fin, amt_fin, 0))
+        pv_f = pos_write(pv_f, acc, jnp.where(used_fin, avail_fin, 0))
+        pu_f = pos_write(pu_f, acc, used_fin)
 
         # taker balance credit: sum of fill * improvement (maker credit is
         # size * 0 == 0 — the structural fact the scheduler relies on).

@@ -1,0 +1,82 @@
+"""Capture a jax.profiler trace of the lane scan and print the top
+device ops by self-time, aggregated from the trace JSON."""
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import kme_tpu._jaxsetup  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kme_tpu.engine import lanes as L
+
+
+def main():
+    S, N, A, E, T = 1024, 128, 2048, 16, 128
+    if len(sys.argv) > 2:
+        S, N, A, E, T = map(int, sys.argv[2:7])
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/kme_trace"
+    cfg = L.LaneConfig(lanes=S, slots=N, accounts=A, max_fills=E, steps=T)
+    state = L.make_lane_state(cfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "act": jnp.asarray(rng.integers(0, 3, (T, S)), jnp.int32),
+        "oid": jnp.asarray(rng.integers(1, 1 << 50, (T, S)), jnp.int64),
+        "aid": jnp.asarray(rng.integers(0, A, (T, S)), jnp.int32),
+        "price": jnp.asarray(rng.integers(0, 126, (T, S)), jnp.int32),
+        "size": jnp.asarray(rng.integers(1, 100, (T, S)), jnp.int32),
+    }
+    step = jax.jit(L.build_lane_step(cfg))
+    state, outs = step(state, batch)   # compile + warm
+    np.asarray(state["err"])
+
+    jax.profiler.start_trace(out_dir)
+    state, outs = step(state, batch)
+    np.asarray(state["err"])
+    jax.profiler.stop_trace()
+
+    paths = glob.glob(os.path.join(out_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not paths:
+        print("no trace json found under", out_dir, file=sys.stderr)
+        return
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # device-side complete events: pick pids whose process name mentions
+    # TPU; fall back to all X events
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in events if e.get("ph") == "M"
+                 and e.get("name") == "process_name" and "args" in e}
+    dev_pids = {p for p, n in pid_names.items()
+                if "TPU" in n or "tpu" in n or "Device" in n}
+    agg = defaultdict(float)
+    cnt = defaultdict(int)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if dev_pids and e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "?")
+        dur = float(e.get("dur", 0.0))
+        agg[name] += dur
+        cnt[name] += 1
+        total += dur
+    print(f"pids seen: {sorted(pid_names.items())}", file=sys.stderr)
+    print(f"total device op time: {total/1e3:.1f} ms", file=sys.stderr)
+    for name, dur in sorted(agg.items(), key=lambda kv: -kv[1])[:30]:
+        print(f"{dur/1e3:10.2f} ms  x{cnt[name]:<6d} {name[:110]}",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
