@@ -412,23 +412,23 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         # envelope: trade_acc & rest_want implies have_free)
         # Q9 prev-echo: tail of my price bucket = max seqno among used
         # same-price slots on my side
-        o_price, o_seq_ = own(st["slot_price"]), own(st["slot_seq"])
+        o_price, o_seq_ = own(sl["slot_price"]), own(sl["slot_seq"])
         same_level = o_used_pre & (o_price == price[:, None])
         bucket_nonempty = jnp.any(same_level, axis=1)
         tail_idx = jnp.argmax(
             jnp.where(same_level, o_seq_, -1), axis=1).astype(_I32)
-        tail_oid = _ta1(own(st["slot_oid"]), tail_idx)
+        tail_oid = _ta1(own(sl["slot_oid"]), tail_idx)
 
         do_rest = rest_want & trade_acc
-        seqno = st["seq"]
+        seqno = seq_v
         # one-hot write of the rested order into (lane, side, free_idx)
         slot_oh = (free_idx[:, None] == jnp.arange(N, dtype=_I32))[:, None, :]
-        wr = side_oh & slot_oh & do_rest[:, None, None]      # (S, 2, N)
-        slot_oid = jnp.where(wr, oid[:, None, None], st["slot_oid"])
-        slot_aid = jnp.where(wr, aid[:, None, None], st["slot_aid"])
-        slot_price = jnp.where(wr, price[:, None, None], st["slot_price"])
+        wr = side_oh & slot_oh & do_rest[:, None, None]      # (X, 2, N)
+        slot_oid = jnp.where(wr, oid[:, None, None], sl["slot_oid"])
+        slot_aid = jnp.where(wr, aid[:, None, None], sl["slot_aid"])
+        slot_price = jnp.where(wr, price[:, None, None], sl["slot_price"])
         slot_size = jnp.where(wr, residual[:, None, None], slot_size)
-        slot_seq = jnp.where(wr, seqno[:, None, None], st["slot_seq"])
+        slot_seq = jnp.where(wr, seqno[:, None, None], sl["slot_seq"])
         slot_used = slot_used | wr
         seq = seqno + do_rest.astype(_I32)
 
@@ -436,24 +436,24 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         # removeOrder (KProcessor.java:289-323): slot lookup by oid +
         # ownership, then margin release (postRemoveAdjustments :325-333)
         is_cancel = act == L_CANCEL
-        hit = st["slot_used"] & (st["slot_oid"] == oid[:, None, None])
-        hit_flat = hit.reshape(S, 2 * N)
+        hit = sl["slot_used"] & (sl["slot_oid"] == oid[:, None, None])
+        hit_flat = hit.reshape(X, 2 * N)
         hit_any = jnp.any(hit_flat, axis=1)
         hit_idx = jnp.argmax(hit_flat, axis=1).astype(_I32)
         h_side = hit_idx // N
-        c_aid = _ta1(st["slot_aid"].reshape(S, 2 * N), hit_idx)
-        c_price = _ta1(st["slot_price"].reshape(S, 2 * N), hit_idx)
-        c_size = _ta1(st["slot_size"].reshape(S, 2 * N), hit_idx)
+        c_aid = _ta1(sl["slot_aid"].reshape(X, 2 * N), hit_idx)
+        c_price = _ta1(sl["slot_price"].reshape(X, 2 * N), hit_idx)
+        c_size = _ta1(sl["slot_size"].reshape(X, 2 * N), hit_idx)
         cancel_ok = is_cancel & hit_any & (c_aid == aid)
         clear = ((hit_idx[:, None] == jnp.arange(2 * N, dtype=_I32))
-                 & cancel_ok[:, None]).reshape(S, 2, N)
+                 & cancel_ok[:, None]).reshape(X, 2, N)
         slot_used = slot_used & ~clear
         # margin release
         c_isbuy = h_side == 0
         c_signed = jnp.where(c_isbuy, c_size, -c_size).astype(_I64)
-        cp_used = _ta1(pos_used, aid)
-        cp_amt = _ta1(pos_amt, aid)
-        cp_avail_raw = _ta1(pos_avail, aid)
+        cp_used = pos_read(pu_f, aid)
+        cp_amt = pos_read(pa_f, aid)
+        cp_avail_raw = pos_read(pv_f, aid)
         cp_avail = jnp.where(cp_used, cp_avail_raw, 0)
         blocked = jnp.where(cp_used, cp_amt - cp_avail, 0)
         c_adj = jnp.where(c_isbuy,
@@ -462,7 +462,7 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         c_unit = jnp.where(c_isbuy, c_price, c_price - 100).astype(_I64)
         c_release = (c_signed + c_adj) * c_unit
         c_adj_write = cancel_ok & (c_adj != 0)
-        pos_avail = _pa1(pos_avail, aid,
+        pv_f = pos_write(pv_f, aid,
                          cp_avail_raw + jnp.where(c_adj_write, c_adj, 0))
 
         # ------------------------------------------- balance delta merge
@@ -492,15 +492,33 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
                                           jnp.where(act == L_ADD_SYMBOL,
                                                     addsym_ok, act == L_NOP)))))
 
-        new_st = {
+        new_rows = {
             "slot_oid": slot_oid, "slot_aid": slot_aid,
             "slot_price": slot_price, "slot_size": slot_size,
             "slot_seq": slot_seq, "slot_used": slot_used,
-            "seq": seq, "book_exists": book_exists,
-            "pos_amt": pos_amt, "pos_avail": pos_avail, "pos_used": pos_used,
-            "bal": bal, "bal_used": bal_used, "err": err,
-            "fillbuf": st["fillbuf"], "filloff": st["filloff"],
         }
+        if compact:
+            # Scatter the W updated rows back into the full device state.
+            # Duplicate indices only occur on the scrap lane (padding,
+            # act=NOP), whose computed rows are bitwise identity — so the
+            # duplicate-index scatter is deterministic by construction.
+            new_st = dict(st)
+            for k, v in new_rows.items():
+                new_st[k] = st[k].at[lanes].set(v)
+            new_st["seq"] = st["seq"].at[lanes].set(seq)
+            new_st["book_exists"] = st["book_exists"].at[lanes].set(book_exists)
+            new_st["pos_amt"] = pa_f.reshape(S, A)
+            new_st["pos_avail"] = pv_f.reshape(S, A)
+            new_st["pos_used"] = pu_f.reshape(S, A)
+            new_st.update(bal=bal, bal_used=bal_used, err=err)
+        else:
+            new_st = {
+                **new_rows,
+                "seq": seq, "book_exists": book_exists,
+                "pos_amt": pa_f, "pos_avail": pv_f, "pos_used": pu_f,
+                "bal": bal, "bal_used": bal_used, "err": err,
+                "fillbuf": st["fillbuf"], "filloff": st["filloff"],
+            }
         outs = {
             "ok": ok,
             "residual": jnp.where(trade_acc, residual, size).astype(_I32),
