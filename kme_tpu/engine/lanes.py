@@ -564,29 +564,41 @@ def chunk_compaction(cfg: LaneConfig, T: int, M: int, step,
     the outputs — the small-scale path used under shard_map test meshes,
     where GSPMD owns data movement and transfer volume is irrelevant.
 
+    Under active-lane compaction (cfg.width > 0) the scan grid is
+    (T, W) message slots instead of (T, S) lanes: cb carries a "slot"
+    coordinate (position within the step, assigned by the scheduler's
+    width cap) and the per-step batch includes the (T, W) lane map.
+    Padding slots point at the scrap lane S-1 with act=NOP, so their
+    row writes are bitwise identity.
+
     t >= T marks padding entries."""
     S, E = cfg.lanes, cfg.max_fills
     FB = cfg.fill_buffer
+    compact = cfg.width > 0
+    X = cfg.width if compact else S
 
     def chunk(state, cb):
         valid = cb["t"] < T
-        flat = jnp.where(valid, cb["t"] * S + cb["lane"], T * S).astype(_I32)
+        col = cb["slot"] if compact else cb["lane"]
+        flat = jnp.where(valid, cb["t"] * X + col, T * X).astype(_I32)
 
-        def grid(v, dt):
-            z = jnp.zeros((T * S + 1,), dt)
-            return z.at[flat].set(v.astype(dt))[:T * S].reshape(T, S)
+        def grid(v, dt, fill=0):
+            z = jnp.full((T * X + 1,), fill, dt)
+            return z.at[flat].set(v.astype(dt))[:T * X].reshape(T, X)
 
         batch = {
             "act": grid(cb["act"], _I32), "oid": grid(cb["oid"], _I64),
             "aid": grid(cb["aid"], _I32), "price": grid(cb["price"], _I32),
             "size": grid(cb["size"], _I32),
         }
+        if compact:
+            batch["lane"] = grid(cb["lane"], _I32, fill=S - 1)
         state, outs = step(state, batch)
 
-        gflat = jnp.minimum(flat, T * S - 1)
+        gflat = jnp.minimum(flat, T * X - 1)
 
-        def pick(a):  # (T, S, ...) -> (M, ...) per-message gather
-            return a.reshape((T * S,) + a.shape[2:])[gflat]
+        def pick(a):  # (T, X, ...) -> (M, ...) per-message gather
+            return a.reshape((T * X,) + a.shape[2:])[gflat]
 
         nfill = jnp.where(valid, pick(outs["nfill"]), 0)
         total = jnp.sum(nfill)

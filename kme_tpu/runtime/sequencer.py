@@ -43,7 +43,9 @@ class EnvelopeError(RuntimeError):
 
 @dataclasses.dataclass
 class Placed:
-    """A device-executed message: its (segment, step, lane) coordinates."""
+    """A device-executed message: its (segment, step, lane) coordinates.
+    Under active-lane compaction `slot` is the message's position within
+    its step (0..width-1) — the column of the (T, W) scan grid."""
     msg_index: int
     segment: int
     step: int       # step within segment
@@ -53,6 +55,7 @@ class Placed:
     oid: int
     price: int
     size: int
+    slot: int = 0
 
 
 @dataclasses.dataclass
@@ -85,9 +88,13 @@ _TRADE_ACTS = {op.BUY: L.L_BUY, op.SELL: L.L_SELL}
 
 
 class Scheduler:
-    def __init__(self, num_lanes: int, num_accounts: int) -> None:
+    def __init__(self, num_lanes: int, num_accounts: int,
+                 width: int = 0) -> None:
+        if width < 0:
+            raise ValueError(f"width must be >= 0, got {width}")
         self.S = num_lanes
         self.A = num_accounts
+        self.width = width  # >0: at most `width` messages per scan step
         self.aid_idx: Dict[int, int] = {}
         self.sid_lane: Dict[int, int] = {}
         self.oid_sid: Dict[int, int] = {}
@@ -137,11 +144,13 @@ class Scheduler:
 
         lane_next = [0] * self.S
         actor_next: Dict[int, int] = {}
+        step_fill: Dict[int, int] = {}  # step -> messages placed (width cap)
+        first_open = 0  # monotone watermark: every step below it is full
         seg = 0
         seg_height = 0  # steps used so far in the current segment
 
         def close_segment():
-            nonlocal seg, seg_height, lane_next
+            nonlocal seg, seg_height, lane_next, step_fill, first_open
             if seg_height > 0:
                 segment_steps.append(seg_height)
                 program.append(("scan", len(segment_steps) - 1))
@@ -149,16 +158,29 @@ class Scheduler:
             lane_next = [0] * self.S
             for k in actor_next:
                 actor_next[k] = 0
+            step_fill = {}
+            first_open = 0
             seg_height = 0
 
         def place(i: int, lane: int, lane_act: int, aidx: int,
                   m: OrderMsg, actor_key: Optional[int]) -> None:
-            nonlocal seg_height
+            nonlocal seg_height, first_open
             step = lane_next[lane]
             if actor_key is not None:
                 step = max(step, actor_next.get(actor_key, 0))
+            slot = 0
+            if self.width > 0:
+                # step_fill counts only grow, so all steps below
+                # first_open stay full — start the scan there
+                step = max(step, first_open)
+                while step_fill.get(step, 0) >= self.width:
+                    step += 1
+                slot = step_fill.get(step, 0)
+                step_fill[step] = slot + 1
+                while step_fill.get(first_open, 0) >= self.width:
+                    first_open += 1
             placements.append(Placed(i, seg, step, lane, lane_act, aidx,
-                                     m.oid, m.price, m.size))
+                                     m.oid, m.price, m.size, slot))
             lane_next[lane] = step + 1
             if actor_key is not None:
                 actor_next[actor_key] = step + 1

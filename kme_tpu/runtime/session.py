@@ -72,8 +72,25 @@ class LaneSession:
     (kme_tpu/parallel/mesh.py); the output stream is bit-identical for
     any shard count — the determinism contract of SURVEY.md §5."""
 
-    def __init__(self, cfg: L.LaneConfig, shards: int = 1) -> None:
-        self.cfg = cfg
+    def __init__(self, cfg: L.LaneConfig, shards: int = 1,
+                 width: int = 16) -> None:
+        """width > 0 (single-device only) enables active-lane compaction:
+        the scheduler caps each scan step at `width` messages and the
+        device computes (T, width) message slots instead of (T, S) lanes
+        — per-step work drops from O(S·(N+A)) to O(width·N). cfg.width,
+        if set, wins over the argument; the sharded path is always
+        full-width (GSPMD owns the lane axis there)."""
+        W = cfg.width if cfg.width > 0 else width
+        # at most one message per lane per step can ever be scheduled, so
+        # wider-than-S slots would be permanently dead padding
+        W = min(W, cfg.lanes)
+        if shards > 1 or W < 0:
+            W = 0
+        self.cfg = cfg = dataclasses.replace(cfg, width=0)
+        # device config: compaction reserves the last lane as the padding
+        # scrap row, so the device state carries one extra lane
+        self.dev_cfg = (dataclasses.replace(cfg, lanes=cfg.lanes + 1,
+                                            width=W) if W else cfg)
         self.shards = shards
         self._chunk_cache: Dict[tuple, object] = {}
         if shards > 1:
@@ -85,15 +102,16 @@ class LaneSession:
                                    donate_argnums=(0,))
         else:
             self.mesh = None
-            self.state = L.make_lane_state(cfg)
-            self._settle = jax.jit(L.build_barrier_ops(cfg), donate_argnums=(0,))
-        self.scheduler = Scheduler(cfg.lanes, cfg.accounts)
+            self.state = L.make_lane_state(self.dev_cfg)
+            self._settle = jax.jit(L.build_barrier_ops(self.dev_cfg),
+                                   donate_argnums=(0,))
+        self.scheduler = Scheduler(cfg.lanes, cfg.accounts, width=W)
 
     # ------------------------------------------------------------------
 
     def _chunk_fn(self, T: int, M: int):
         if self.shards == 1:
-            return L.build_lane_chunk(self.cfg, T, M)
+            return L.build_lane_chunk(self.dev_cfg, T, M)
         key = (T, M)
         fn = self._chunk_cache.get(key)
         if fn is None:
@@ -111,6 +129,7 @@ class LaneSession:
         cb = {
             "t": np.full(M, T, np.int32),     # t >= T marks padding
             "lane": np.zeros(M, np.int32),
+            "slot": np.zeros(M, np.int32),
             "act": np.zeros(M, np.int32),
             "oid": np.zeros(M, np.int64),
             "aid": np.zeros(M, np.int32),
@@ -120,6 +139,7 @@ class LaneSession:
         for m, p in enumerate(placements):
             cb["t"][m] = p.step - t0
             cb["lane"][m] = p.lane
+            cb["slot"][m] = p.slot
             cb["act"][m] = p.lane_act
             cb["oid"][m] = jl.jlong(p.oid)
             cb["aid"][m] = p.aid_idx
@@ -191,7 +211,7 @@ class LaneSession:
                 fills = np.asarray(self.state["fillbuf"][:, :base])
             else:
                 fills = np.zeros((4, 0), np.int64)
-            self.state = L.build_fill_reset(self.cfg)(self.state)
+            self.state = L.build_fill_reset(self.dev_cfg)(self.state)
             return fills
         return np.zeros((4, 0), np.int64)
 

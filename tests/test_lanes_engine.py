@@ -19,8 +19,8 @@ from kme_tpu.workload import cancel_heavy_stream, harness_stream, zipf_symbol_st
 CFG = LaneConfig(lanes=8, slots=128, accounts=64, max_fills=32, steps=32)
 
 
-def assert_lane_parity(msgs, cfg=CFG):
-    ses = LaneSession(cfg)
+def assert_lane_parity(msgs, cfg=CFG, width=16):
+    ses = LaneSession(cfg, width=width)
     ora = OracleEngine("fixed")
     got = ses.process(msgs)
     for i, m in enumerate(msgs):
@@ -37,7 +37,10 @@ def assert_lane_parity(msgs, cfg=CFG):
     return ses, ora
 
 
-def test_lane_scenario_end_to_end():
+@pytest.mark.parametrize("width", [0, 1, 16])
+def test_lane_scenario_end_to_end(width):
+    """width=0 keeps the single-device full-width path covered; width=1
+    forces maximal step-bumping through the compaction scheduler."""
     msgs = []
     for a in range(4):
         msgs.append(OrderMsg(action=op.CREATE_BALANCE, aid=a))
@@ -65,7 +68,7 @@ def test_lane_scenario_end_to_end():
         OrderMsg(action=op.TRANSFER, aid=9, size=5),
         OrderMsg(action=99, oid=0, aid=0),
     ]
-    assert_lane_parity(msgs)
+    assert_lane_parity(msgs, width=width)
 
 
 def test_lane_self_cross_and_zero_residual():
