@@ -24,21 +24,21 @@ import time
 REFERENCE_BASELINE_OPS = 5_000.0  # orders/sec, derived bound (BASELINE.md)
 
 
-def _assert_parity_prefix(msgs, cfg, shards, prefix: int) -> None:
+def _assert_parity_prefix(msgs, cfg, shards, prefix: int,
+                          width: int = 16) -> None:
     """Replay `prefix` messages through a throwaway session and the
     scalar oracle (with the matching capacity envelope); require
     byte-identical wire streams."""
     from kme_tpu.oracle import OracleEngine
     from kme_tpu.runtime.session import LaneSession
 
-    ses = LaneSession(cfg, shards=shards)
+    ses = LaneSession(cfg, shards=shards, width=width)
     ora = OracleEngine("fixed", book_slots=cfg.slots,
                        max_fills=cfg.max_fills)
-    got = ses.process(msgs[:prefix])
+    got = ses.process_wire(msgs[:prefix])
     for i in range(prefix):
         want = [r.wire() for r in ora.process(msgs[i].copy())]
-        g = [r.wire() for r in got[i]]
-        assert g == want, f"bench parity prefix diverged at message {i}"
+        assert got[i] == want, f"bench parity prefix diverged at message {i}"
 
 
 def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
@@ -46,7 +46,7 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
                       zipf_a: float = 1.2, steps: int = 64,
                       slots: int = 128, max_fills: int = 16,
                       shards: int = 1, parity_prefix: int = 2000,
-                      profile_dir: str = None) -> dict:
+                      width: int = 16, profile_dir: str = None) -> dict:
     """End-to-end lane-engine throughput (see module docstring)."""
     import jax
 
@@ -64,15 +64,15 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
     # extends past the preamble into the trade mix
     preamble = 2 * accounts + symbols
     prefix = min(preamble + parity_prefix, len(msgs))
-    _assert_parity_prefix(msgs, cfg, shards, prefix)
+    _assert_parity_prefix(msgs, cfg, shards, prefix, width)
 
     # warmup run on a fresh session: compiles every (T, M) bucket the
     # timed run will hit (compiled executables are shared via the
     # module-level chunk cache)
-    LaneSession(cfg, shards=shards).process(msgs)
+    LaneSession(cfg, shards=shards, width=width).process(msgs)
 
     # timed run, phase by phase (sum = the honest end-to-end number)
-    ses = LaneSession(cfg, shards=shards)
+    ses = LaneSession(cfg, shards=shards, width=width)
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
     try:
@@ -90,7 +90,7 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
         t_fetch = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        records = ses._reconstruct(msgs, sched, runs, barrier_ok, fills)
+        records = ses._reconstruct_wire(msgs, sched, runs, barrier_ok, fills)
         t_recon = time.perf_counter() - t0
     finally:
         if profile_dir:
@@ -101,9 +101,9 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
     nfills = sum(int(r.host["nfill_total"]) for r in runs)
     # slice to the real placements: the M bucket is padded and padding
     # entries report ok=False
-    cap_rejects = sum(int(r.host["cap_reject"][:len(r.placements)].sum())
+    cap_rejects = sum(int(r.host["cap_reject"][:len(r.idx)].sum())
                       for r in runs)
-    rejects = sum(int((~r.host["ok"][:len(r.placements)]).sum())
+    rejects = sum(int((~r.host["ok"][:len(r.idx)]).sum())
                   for r in runs)
     n_records = sum(len(r) for r in records)
     steps_total = sum(sched.segment_steps)
@@ -116,7 +116,7 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
         "detail": {
             "events": n, "symbols": symbols, "accounts": accounts,
             "zipf_a": zipf_a, "shards": shards, "slots": slots,
-            "max_fills": max_fills,
+            "max_fills": max_fills, "width": width,
             "plan_s": round(t_plan, 3), "dispatch_s": round(t_disp, 3),
             "fetch_s": round(t_fetch, 3), "recon_s": round(t_recon, 3),
             "total_s": round(total, 3),
@@ -180,6 +180,9 @@ def main(argv=None) -> int:
                    help="makers swept per taker (H3 envelope)")
     p.add_argument("--steps", type=int, default=64,
                    help="scan-length bucket granularity of dispatch windows")
+    p.add_argument("--width", type=int, default=16,
+                   help="active-lane compaction: messages per scan step "
+                        "(0 = full-width)")
     p.add_argument("--parity-prefix", type=int, default=2000,
                    help="post-preamble messages checked against the oracle")
     p.add_argument("--profile", default=None, metavar="DIR",
@@ -194,7 +197,7 @@ def main(argv=None) -> int:
                                 steps=args.steps, slots=args.slots,
                                 max_fills=args.max_fills, shards=args.shards,
                                 parity_prefix=args.parity_prefix,
-                                profile_dir=args.profile)
+                                width=args.width, profile_dir=args.profile)
     else:
         rec = bench_parity_engine(args.events or 4096, args.seed, args.batch,
                                   args.compat)

@@ -98,6 +98,9 @@ class LaneConfig:
     # device state to lanes+1). Single-device only; the sharded path
     # ignores width.
     width: int = 0            # W — max active lanes per scan step
+    # scan-body unroll factor: amortizes XLA loop overhead and lets the
+    # compiler fuse across adjacent steps; shapes are unchanged
+    unroll: int = 1
 
 
 def make_lane_state(cfg: LaneConfig):
@@ -111,9 +114,16 @@ def make_lane_state(cfg: LaneConfig):
         "slot_used": jnp.zeros((S, 2, N), bool),
         "seq": jnp.zeros((S,), _I32),
         "book_exists": jnp.zeros((S,), bool),
-        "pos_amt": jnp.zeros((S, A), _I64),
-        "pos_avail": jnp.zeros((S, A), _I64),
-        "pos_used": jnp.zeros((S, A), bool),
+        # positions are kept FLAT (S*A,) — lane-major, index lane*A+acc.
+        # A 2-D (S, A) layout costs a physical re-tiling copy per scan
+        # step on TPU for the reshape to flat scatter indices (profiled:
+        # ~100us/step in reshape copies + un-aliased scatters); flat
+        # arrays scatter in place under the donated carry.
+        # There is no `used` flag: in fixed mode a position exists iff
+        # amt != 0 (delete-at-zero, KProcessor.java:281-284 corrected),
+        # and the engine maintains avail == 0 whenever amt == 0.
+        "pos_amt": jnp.zeros((S * A,), _I64),
+        "pos_avail": jnp.zeros((S * A,), _I64),
         "bal": jnp.zeros((A,), _I64),
         "bal_used": jnp.zeros((A,), bool),
         "err": jnp.zeros((), _I32),
@@ -180,37 +190,25 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
             sl = {k: st[k][lanes] for k in _ROW_KEYS}   # (W, 2, N) rows
             seq_v = st["seq"][lanes]
             be_v = st["book_exists"][lanes]
-            # positions via flat lane*A+acc indices on the (S*A,) view:
-            # the update count drops from S*2E to W*2E scalar scatters
-            pbase = lanes * A                       # (W,) int32; S*A < 2^31
-            pa_f = st["pos_amt"].reshape(-1)
-            pv_f = st["pos_avail"].reshape(-1)
-            pu_f = st["pos_used"].reshape(-1)
-
-            def pos_read(arr_f, accs):              # accs: (W,) | (W, K)
-                idx = pbase[:, None] + accs if accs.ndim == 2 else pbase + accs
-                return arr_f[idx]
-
-            def pos_write(arr_f, accs, vals):
-                idx = pbase[:, None] + accs if accs.ndim == 2 else pbase + accs
-                return arr_f.at[idx].set(vals.astype(arr_f.dtype))
         else:
+            lanes = jnp.arange(S, dtype=_I32)
             sl = {k: st[k] for k in _ROW_KEYS}
             seq_v = st["seq"]
             be_v = st["book_exists"]
-            pa_f, pv_f, pu_f = st["pos_amt"], st["pos_avail"], st["pos_used"]
 
-            def pos_read(arr, accs):
-                if accs.ndim == 2:
-                    return jnp.take_along_axis(arr, accs, axis=1)
-                return _ta1(arr, accs)
+        # positions via flat lane*A+acc indices — the state arrays are
+        # already flat (make_lane_state), so the scatters alias in place
+        pbase = lanes * A                           # (X,) int32; S*A < 2^31
+        pa_f = st["pos_amt"]
+        pv_f = st["pos_avail"]
 
-            def pos_write(arr, accs, vals):
-                if accs.ndim == 2:
-                    return jnp.put_along_axis(arr, accs,
-                                              vals.astype(arr.dtype),
-                                              axis=1, inplace=False)
-                return _pa1(arr, accs, vals)
+        def pos_read(arr_f, accs):                  # accs: (X,) | (X, K)
+            idx = pbase[:, None] + accs if accs.ndim == 2 else pbase + accs
+            return arr_f[idx]
+
+        def pos_write(arr_f, accs, vals):
+            idx = pbase[:, None] + accs if accs.ndim == 2 else pbase + accs
+            return arr_f.at[idx].set(vals.astype(arr_f.dtype))
 
         is_trade = (act == L_BUY) | (act == L_SELL)
         is_buy = act == L_BUY
@@ -250,8 +248,7 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         valid = (price >= 0) & (price < 126) & (size > 0)
         signed = jnp.where(is_buy, size, -size).astype(_I32)
         signed64 = signed.astype(_I64)
-        p_avail = jnp.where(pos_read(pu_f, aid),
-                            pos_read(pv_f, aid), 0)
+        p_avail = pos_read(pv_f, aid)  # == 0 when no position exists
         adj = jnp.where(is_buy,
                         jnp.maximum(jnp.minimum(p_avail, 0), -signed64),
                         jnp.minimum(jnp.maximum(p_avail, 0), -signed64))
@@ -366,9 +363,8 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         fv = (fo_fill > 0) & trade_acc[:, None]
         fvalid = jnp.zeros((X, twoE), bool).at[:, 0::2].set(fv)
         fvalid = fvalid.at[:, 1::2].set(fv)
-        pu_acc = pos_read(pu_f, acc)
-        a0 = jnp.where(pu_acc, pos_read(pa_f, acc), 0)
-        v0 = jnp.where(pu_acc, pos_read(pv_f, acc), 0)
+        a0 = pos_read(pa_f, acc)   # 0 when no position exists
+        v0 = pos_read(pv_f, acc)
         # eq[s, i, j]: entry i is a VALID contributor to entry j's account.
         # Only the contributor side is validity-gated: every entry j —
         # valid or not — then computes its account's exact final value, so
@@ -393,10 +389,11 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         avail_fin = jnp.where(anyzero, avail_sum, v0 + total)
         used_fin = amt_fin != 0
         # untouched accounts land on identity writes (amt_fin = a0 etc.),
-        # so no masking is needed: scatter values directly
-        pa_f = pos_write(pa_f, acc, jnp.where(used_fin, amt_fin, 0))
+        # so no masking is needed: scatter values directly. Deleted
+        # positions (amt_fin == 0) write avail = 0 — the no-used-flag
+        # invariant.
+        pa_f = pos_write(pa_f, acc, amt_fin)
         pv_f = pos_write(pv_f, acc, jnp.where(used_fin, avail_fin, 0))
-        pu_f = pos_write(pu_f, acc, used_fin)
 
         # taker balance credit: sum of fill * improvement (maker credit is
         # size * 0 == 0 — the structural fact the scheduler relies on).
@@ -451,11 +448,10 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         # margin release
         c_isbuy = h_side == 0
         c_signed = jnp.where(c_isbuy, c_size, -c_size).astype(_I64)
-        cp_used = pos_read(pu_f, aid)
         cp_amt = pos_read(pa_f, aid)
         cp_avail_raw = pos_read(pv_f, aid)
-        cp_avail = jnp.where(cp_used, cp_avail_raw, 0)
-        blocked = jnp.where(cp_used, cp_amt - cp_avail, 0)
+        # amt == avail == 0 when no position exists, so blocked == 0
+        blocked = cp_amt - cp_avail_raw
         c_adj = jnp.where(c_isbuy,
                           jnp.maximum(jnp.minimum(blocked, 0), -c_signed),
                           jnp.minimum(jnp.maximum(blocked, 0), -c_signed))
@@ -507,15 +503,14 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
                 new_st[k] = st[k].at[lanes].set(v)
             new_st["seq"] = st["seq"].at[lanes].set(seq)
             new_st["book_exists"] = st["book_exists"].at[lanes].set(book_exists)
-            new_st["pos_amt"] = pa_f.reshape(S, A)
-            new_st["pos_avail"] = pv_f.reshape(S, A)
-            new_st["pos_used"] = pu_f.reshape(S, A)
+            new_st["pos_amt"] = pa_f
+            new_st["pos_avail"] = pv_f
             new_st.update(bal=bal, bal_used=bal_used, err=err)
         else:
             new_st = {
                 **new_rows,
                 "seq": seq, "book_exists": book_exists,
-                "pos_amt": pa_f, "pos_avail": pv_f, "pos_used": pu_f,
+                "pos_amt": pa_f, "pos_avail": pv_f,
                 "bal": bal, "bal_used": bal_used, "err": err,
                 "fillbuf": st["fillbuf"], "filloff": st["filloff"],
             }
@@ -533,7 +528,7 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         return new_st, outs
 
     def step(state, batch):
-        return jax.lax.scan(one_step, state, batch)
+        return jax.lax.scan(one_step, state, batch, unroll=cfg.unroll)
 
     return step
 
@@ -697,7 +692,7 @@ def build_barrier_ops(cfg: LaneConfig, axis_name: Optional[str] = None):
         n_used = jnp.sum(used)
 
         def body(i, carry):
-            pos_amt, pos_avail, pos_used, bal_delta = carry
+            pos_amt, pos_avail, bal_delta = carry
             flat = order[i]
             s_side = flat // N
             s_slot = flat % N
@@ -708,8 +703,8 @@ def build_barrier_ops(cfg: LaneConfig, axis_name: Optional[str] = None):
             isbuy = s_side == 0
             signed = jnp.where(isbuy, sz, -sz).astype(_I64)
             amt = pos_amt[a]
-            avail = jnp.where(pos_used[a], pos_avail[a], 0)
-            blocked = jnp.where(pos_used[a], amt - avail, 0)
+            avail = pos_avail[a]        # 0 when no position exists
+            blocked = amt - avail
             adj = jnp.where(isbuy,
                             jnp.maximum(jnp.minimum(blocked, 0), -signed),
                             jnp.minimum(jnp.maximum(blocked, 0), -signed))
@@ -717,16 +712,18 @@ def build_barrier_ops(cfg: LaneConfig, axis_name: Optional[str] = None):
             release = (signed + adj) * unit
             pos_avail = pos_avail.at[a].add(jnp.where(active & (adj != 0), adj, 0))
             bal_delta = bal_delta.at[a].add(jnp.where(active, release, 0))
-            return pos_amt, pos_avail, pos_used, bal_delta
+            return pos_amt, pos_avail, bal_delta
 
         # zero delta derived from lane-sharded state so its varying-axis
         # type matches the loop body's output under shard_map
         zv64 = (st["seq"][0] * 0).astype(_I64)
-        carry = (st["pos_amt"][lane], st["pos_avail"][lane],
-                 st["pos_used"][lane], jnp.zeros((A,), _I64) + zv64)
-        pos_amt_l, pos_avail_l, pos_used_l, bal_delta = jax.lax.fori_loop(
+        pbase = lane * A  # positions are flat (S*A,) lane-major
+        carry = (jax.lax.dynamic_slice_in_dim(st["pos_amt"], pbase, A),
+                 jax.lax.dynamic_slice_in_dim(st["pos_avail"], pbase, A),
+                 jnp.zeros((A,), _I64) + zv64)
+        pos_amt_l, pos_avail_l, bal_delta = jax.lax.fori_loop(
             0, 2 * N, body, carry)
-        return pos_amt_l, pos_avail_l, pos_used_l, bal_delta
+        return pos_amt_l, pos_avail_l, bal_delta
 
     def settle(state, lane, credit_size, mode):
         """mode: 0 = REMOVE_SYMBOL, 1 = PAYOUT YES, 2 = PAYOUT NO.
@@ -736,34 +733,41 @@ def build_barrier_ops(cfg: LaneConfig, axis_name: Optional[str] = None):
         lane=-1."""
         do = (lane >= 0) & state["book_exists"][jnp.maximum(lane, 0)]
         lane_c = jnp.maximum(lane, 0)
-        pos_amt_l, pos_avail_l, pos_used_l, bal_delta = wipe_lane(
-            state, lane_c, do)
+        pos_amt_l, pos_avail_l, bal_delta = wipe_lane(state, lane_c, do)
         st = dict(state)
-        st["pos_amt"] = st["pos_amt"].at[lane_c].set(
-            jnp.where(do, pos_amt_l, st["pos_amt"][lane_c]))
-        st["pos_avail"] = st["pos_avail"].at[lane_c].set(
-            jnp.where(do, pos_avail_l, st["pos_avail"][lane_c]))
+        pbase = lane_c * A
+
+        def upd_pos(key, new_row):
+            cur = jax.lax.dynamic_slice_in_dim(st[key], pbase, A)
+            return jax.lax.dynamic_update_slice_in_dim(
+                st[key], jnp.where(do, new_row, cur).astype(st[key].dtype),
+                pbase, 0)
+
+        st["pos_amt"] = upd_pos("pos_amt", pos_amt_l)
+        st["pos_avail"] = upd_pos("pos_avail", pos_avail_l)
         st["slot_used"] = st["slot_used"].at[lane_c].set(
             jnp.where(do, False, st["slot_used"][lane_c]))
         st["book_exists"] = st["book_exists"].at[lane_c].set(
             jnp.where(do, False, st["book_exists"][lane_c]))
 
-        # payout credit/delete over the lane's positions
+        # payout credit/delete over the lane's positions (a holder is any
+        # account with amt != 0 — the no-used-flag invariant)
         is_payout = mode > 0
         credit = (mode == 1)
         pm = jnp.where(do & is_payout, True, False)
-        holders = st["pos_used"][lane_c]
-        amts = st["pos_amt"][lane_c]
-        pay = jnp.where(pm & credit & holders,
+        amts = jax.lax.dynamic_slice_in_dim(st["pos_amt"], pbase, A)
+        pay = jnp.where(pm & credit,
                         amts * credit_size.astype(_I64), 0)
         bal_delta = bal_delta + pay
-        clear = pm & holders
-        st["pos_used"] = st["pos_used"].at[lane_c].set(
-            jnp.where(clear, False, st["pos_used"][lane_c]))
-        st["pos_amt"] = st["pos_amt"].at[lane_c].set(
-            jnp.where(clear, 0, st["pos_amt"][lane_c]))
-        st["pos_avail"] = st["pos_avail"].at[lane_c].set(
-            jnp.where(clear, 0, st["pos_avail"][lane_c]))
+
+        def clear_pos(key):
+            cur = jax.lax.dynamic_slice_in_dim(st[key], pbase, A)
+            return jax.lax.dynamic_update_slice_in_dim(
+                st[key], jnp.where(pm, 0, cur).astype(st[key].dtype),
+                pbase, 0)
+
+        st["pos_amt"] = clear_pos("pos_amt")
+        st["pos_avail"] = clear_pos("pos_avail")
 
         if axis_name is not None:
             bal_delta = jax.lax.psum(bal_delta, axis_name)

@@ -26,6 +26,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Sequence
 
+import numpy as np
+
 from kme_tpu import opcodes as op
 from kme_tpu.engine import lanes as L
 from kme_tpu.wire import OrderMsg
@@ -73,15 +75,34 @@ class HostReject:
     msg_index: int
 
 
+_COL_DTYPES = (
+    ("msg_index", "int64"), ("segment", "int32"), ("step", "int32"),
+    ("lane", "int32"), ("act", "int32"), ("aidx", "int32"),
+    ("oid", "int64"), ("price", "int32"), ("size", "int32"),
+    ("slot", "int32"),
+)
+
+
 @dataclasses.dataclass
 class Schedule:
     """segments[i] = number of steps in scan segment i; the executable
-    plan alternates scan segments and barriers in `program` order."""
-    placements: List[Placed]
+    plan alternates scan segments and barriers in `program` order.
+
+    Placements are COLUMNAR (`cols`: one numpy array per field, rows in
+    arrival order — so `segment` and, per lane, `step` are nondecreasing
+    by construction); the device pack path slices them without touching
+    Python objects. `placements` materializes row objects for tests."""
+    cols: dict                # field -> np.ndarray, aligned rows
     barriers: List[Barrier]
     host_rejects: List[HostReject]
     segment_steps: List[int]
     program: List[tuple]  # ("scan", seg_idx) | ("barrier", barrier_idx)
+
+    @property
+    def placements(self) -> List[Placed]:
+        c = self.cols
+        return [Placed(*(int(c[name][i]) for name, _ in _COL_DTYPES))
+                for i in range(len(c["msg_index"]))]
 
 
 _TRADE_ACTS = {op.BUY: L.L_BUY, op.SELL: L.L_SELL}
@@ -136,7 +157,9 @@ class Scheduler:
 
     def plan(self, msgs: Sequence[OrderMsg]) -> Schedule:
         """Greedy conflict-free placement of a message batch."""
-        placements: List[Placed] = []
+        from kme_tpu.oracle import javalong as jl
+
+        rows = {name: [] for name, _ in _COL_DTYPES}
         barriers: List[Barrier] = []
         host_rejects: List[HostReject] = []
         segment_steps: List[int] = []
@@ -179,8 +202,17 @@ class Scheduler:
                 step_fill[step] = slot + 1
                 while step_fill.get(first_open, 0) >= self.width:
                     first_open += 1
-            placements.append(Placed(i, seg, step, lane, lane_act, aidx,
-                                     m.oid, m.price, m.size, slot))
+            r = rows
+            r["msg_index"].append(i)
+            r["segment"].append(seg)
+            r["step"].append(step)
+            r["lane"].append(lane)
+            r["act"].append(lane_act)
+            r["aidx"].append(aidx)
+            r["oid"].append(jl.jlong(m.oid))
+            r["price"].append(m.price)
+            r["size"].append(m.size)
+            r["slot"].append(slot)
             lane_next[lane] = step + 1
             if actor_key is not None:
                 actor_next[actor_key] = step + 1
@@ -256,5 +288,7 @@ class Scheduler:
             else:
                 host_rejects.append(HostReject(i))  # unknown opcode
         close_segment()
-        return Schedule(placements, barriers, host_rejects, segment_steps,
+        cols = {name: np.array(vals, dtype=dt)
+                for (name, dt), vals in zip(_COL_DTYPES, rows.values())}
+        return Schedule(cols, barriers, host_rejects, segment_steps,
                         program)

@@ -55,11 +55,12 @@ def _bucket(n: int, lo: int = 64) -> int:
 class _WindowRun:
     """A dispatched window: its compact device outputs + bookkeeping.
 
-    placements are sorted by (t, lane) — the exact order the device
+    `idx` are placement ROW ids into the schedule's columnar arrays,
+    sorted by (step-in-window, lane) — the exact order the device
     appends fills to the persistent fill log, so host fill offsets are
-    the running cumsum of nfill in placement order across windows in
-    dispatch order."""
-    placements: list          # Placed, sorted by (step-in-window, lane)
+    the running cumsum of nfill in row order across windows in dispatch
+    order."""
+    idx: np.ndarray           # placement rows, sorted by (step, lane)
     outs: dict                # device arrays (fetched lazily)
     host: dict = None         # np arrays after fetch
     offs: np.ndarray = None   # (M,) absolute fill-log offsets
@@ -122,10 +123,9 @@ class LaneSession:
             self._chunk_cache[key] = fn
         return fn
 
-    def _pack_window(self, placements, t0: int, T: int,
-                     M: int) -> Dict[str, np.ndarray]:
-        from kme_tpu.oracle import javalong as jl
-
+    def _pack_window(self, cols: Dict[str, np.ndarray], widx: np.ndarray,
+                     t0: int, T: int, M: int) -> Dict[str, np.ndarray]:
+        n = len(widx)
         cb = {
             "t": np.full(M, T, np.int32),     # t >= T marks padding
             "lane": np.zeros(M, np.int32),
@@ -136,15 +136,14 @@ class LaneSession:
             "price": np.zeros(M, np.int32),
             "size": np.zeros(M, np.int32),
         }
-        for m, p in enumerate(placements):
-            cb["t"][m] = p.step - t0
-            cb["lane"][m] = p.lane
-            cb["slot"][m] = p.slot
-            cb["act"][m] = p.lane_act
-            cb["oid"][m] = jl.jlong(p.oid)
-            cb["aid"][m] = p.aid_idx
-            cb["price"][m] = p.price  # int32 by EnvelopeError
-            cb["size"][m] = p.size
+        cb["t"][:n] = cols["step"][widx] - t0
+        cb["lane"][:n] = cols["lane"][widx]
+        cb["slot"][:n] = cols["slot"][widx]
+        cb["act"][:n] = cols["act"][widx]
+        cb["oid"][:n] = cols["oid"][widx]
+        cb["aid"][:n] = cols["aidx"][widx]
+        cb["price"][:n] = cols["price"][widx]
+        cb["size"][:n] = cols["size"][widx]
         return cb
 
     def _dispatch(self, sched: Schedule) -> tuple:
@@ -153,9 +152,10 @@ class LaneSession:
         HBM bound for the per-step output grids); nothing syncs with the
         device here. Returns (window runs in dispatch order, barrier-ok
         device scalars by msg index)."""
-        by_seg: Dict[int, list] = {}
-        for p in sched.placements:
-            by_seg.setdefault(p.segment, []).append(p)
+        cols = sched.cols
+        nseg = len(sched.segment_steps)
+        # rows are appended in arrival order, so `segment` is sorted
+        seg_bounds = np.searchsorted(cols["segment"], np.arange(nseg + 1))
 
         runs: List[_WindowRun] = []
         barrier_ok: Dict[int, object] = {}
@@ -164,19 +164,20 @@ class LaneSession:
         W = self.cfg.window
         for kind, idx in sched.program:
             if kind == "scan":
-                placements = by_seg.get(idx, [])
+                lo, hi = int(seg_bounds[idx]), int(seg_bounds[idx + 1])
                 height = sched.segment_steps[idx]
-                by_win: Dict[int, list] = {}
-                for p in placements:
-                    by_win.setdefault(p.step // W, []).append(p)
+                order = lo + np.lexsort((cols["lane"][lo:hi],
+                                         cols["step"][lo:hi]))
+                sorted_steps = cols["step"][order]
                 for w in range((height + W - 1) // W):
-                    wp = sorted(by_win.get(w, []),
-                                key=lambda p: (p.step, p.lane))
+                    a = np.searchsorted(sorted_steps, w * W, "left")
+                    b = np.searchsorted(sorted_steps, (w + 1) * W, "left")
+                    widx = order[a:b]
                     T = _bucket(min(height - w * W, W), lo=self.cfg.steps)
-                    M = _bucket(max(len(wp), 1))
-                    cb = self._pack_window(wp, w * W, T, M)
+                    M = _bucket(max(len(widx), 1))
+                    cb = self._pack_window(cols, widx, w * W, T, M)
                     self.state, outs = self._chunk_fn(T, M)(self.state, cb)
-                    runs.append(_WindowRun(wp, outs))
+                    runs.append(_WindowRun(widx, outs))
             else:
                 b = sched.barriers[idx]
                 self.state, ok = self._settle(
@@ -223,17 +224,136 @@ class LaneSession:
         fills = self._fetch(runs)
         return self._reconstruct(msgs, sched, runs, barrier_ok_dev, fills)
 
+    def process_wire(self, msgs: Sequence[OrderMsg]) -> List[List[str]]:
+        """Like process(), but returns the byte-exact `<key> <json>` wire
+        lines (consumer.js:19 format) directly — no per-record Python
+        objects. This is the serving/bench path; equivalence with
+        process() is pinned by tests/test_lanes_engine.py."""
+        sched = self.scheduler.plan(msgs)
+        runs, barrier_ok_dev = self._dispatch(sched)
+        fills = self._fetch(runs)
+        return self._reconstruct_wire(msgs, sched, runs, barrier_ok_dev,
+                                      fills)
+
+    def _reconstruct_wire(self, msgs, sched, runs, barrier_ok_dev, fills):
+        idx_to_aid = self.scheduler.acct_of_idx()
+        lane_to_sid = self.scheduler.sid_of_lane()
+        barrier_ok = {i: bool(np.asarray(okd))
+                      for i, okd in barrier_ok_dev.items()}
+        cols = sched.cols
+        nmsg = len(msgs)
+        # Per-message scalar state, extracted in BULK (tolist() — numpy
+        # scalar-by-scalar extraction dominates reconstruction otherwise).
+        ok_of = [False] * nmsg
+        nfill_of = [0] * nmsg
+        off_of = [0] * nmsg
+        resid_of = [0] * nmsg
+        prev_of = [0] * nmsg
+        append_of = [False] * nmsg
+        act_of = [0] * nmsg
+        lane_of = [0] * nmsg
+        dense = self.shards > 1
+        dense_fills_of = {}
+        for run in runs:
+            n = len(run.idx)
+            h = run.host
+            mis = cols["msg_index"][run.idx].tolist()
+            for name, dst in (("ok", ok_of), ("nfill", nfill_of),
+                              ("residual", resid_of), ("prev_oid", prev_of),
+                              ("append", append_of)):
+                vals = h[name][:n].tolist()
+                for k, mi in enumerate(mis):
+                    dst[mi] = vals[k]
+            offs = run.offs[:n].tolist()
+            acts = cols["act"][run.idx].tolist()
+            lanes_l = cols["lane"][run.idx].tolist()
+            for k, mi in enumerate(mis):
+                off_of[mi] = offs[k]
+                act_of[mi] = acts[k]
+                lane_of[mi] = lanes_l[k]
+            if dense:
+                for arr, key in ((h["fill_oid"], 0), (h["fill_aid"], 1),
+                                 (h["fill_price"], 2), (h["fill_size"], 3)):
+                    vals = arr[:n].tolist()
+                    for k, mi in enumerate(mis):
+                        dense_fills_of.setdefault(mi, [None] * 4)[key] = vals[k]
+        if dense:
+            f_oid = f_aid = f_price = f_size = None
+        else:
+            f_oid, f_aid, f_price, f_size = (fills[c].tolist()
+                                             for c in range(4))
+        rejects = {r.msg_index for r in sched.host_rejects}
+        barriers = {b.msg_index for b in sched.barriers}
+
+        out: List[List[str]] = []
+        for i, m in enumerate(msgs):
+            nxt = "null" if m.next is None else str(m.next)
+            prv = "null" if m.prev is None else str(m.prev)
+            mid = (f'"oid":{m.oid},"aid":{m.aid},"sid":{m.sid},'
+                   f'"price":{m.price},"size":{m.size},"next":{nxt}')
+            lines = [f'IN {{"action":{m.action},{mid},"prev":{prv}}}']
+            if i in rejects or (i in barriers and not barrier_ok[i]):
+                lines.append(
+                    f'OUT {{"action":{op.REJECT},{mid},"prev":{prv}}}')
+            elif i in barriers:
+                lines.append(f'OUT {{"action":{m.action},{mid},"prev":{prv}}}')
+            else:
+                lane_act = act_of[i]
+                ok = ok_of[i]
+                is_trade = lane_act in (L.L_BUY, L.L_SELL)
+                if is_trade and ok:
+                    sid = lane_to_sid[lane_of[i]]
+                    is_buy = lane_act == L.L_BUY
+                    mk_act = op.SOLD if is_buy else op.BOUGHT
+                    tk_act = op.BOUGHT if is_buy else op.SOLD
+                    o0 = off_of[i]
+                    if dense:
+                        df = dense_fills_of[i]
+                    for e in range(nfill_of[i]):
+                        if dense:
+                            moid, mprice = df[0][e], df[2][e]
+                            maid = idx_to_aid[df[1][e]]
+                            fsz = df[3][e]
+                        else:
+                            moid = f_oid[o0 + e]
+                            maid = idx_to_aid[f_aid[o0 + e]]
+                            mprice = f_price[o0 + e]
+                            fsz = f_size[o0 + e]
+                        lines.append(
+                            f'OUT {{"action":{mk_act},"oid":{moid},'
+                            f'"aid":{maid},"sid":{sid},"price":0,'
+                            f'"size":{fsz},"next":null,"prev":null}}')
+                        lines.append(
+                            f'OUT {{"action":{tk_act},"oid":{m.oid},'
+                            f'"aid":{m.aid},"sid":{sid},'
+                            f'"price":{m.price - mprice},"size":{fsz},'
+                            f'"next":null,"prev":null}}')
+                    esz = resid_of[i]
+                    eprv = str(prev_of[i]) if append_of[i] else prv
+                    lines.append(
+                        f'OUT {{"action":{m.action},"oid":{m.oid},'
+                        f'"aid":{m.aid},"sid":{m.sid},"price":{m.price},'
+                        f'"size":{esz},"next":{nxt},"prev":{eprv}}}')
+                else:
+                    act = m.action if ok else op.REJECT
+                    lines.append(f'OUT {{"action":{act},{mid},"prev":{prv}}}')
+            out.append(lines)
+        return out
+
     def _reconstruct(self, msgs, sched, runs, barrier_ok_dev, fills):
         idx_to_aid = self.scheduler.acct_of_idx()
         lane_to_sid = self.scheduler.sid_of_lane()
         barrier_ok = {i: bool(np.asarray(okd))
                       for i, okd in barrier_ok_dev.items()}
 
-        # m-position of each device message within its window run
-        pos_of_msg: Dict[int, tuple] = {}
-        for run in runs:
-            for m, p in enumerate(run.placements):
-                pos_of_msg[p.msg_index] = (run, m)
+        # run + m-position of each device message within its window run
+        cols = sched.cols
+        run_of_msg = np.full(len(msgs), -1, np.int64)
+        m_of_msg = np.zeros(len(msgs), np.int64)
+        for ri, run in enumerate(runs):
+            mi = cols["msg_index"][run.idx]
+            run_of_msg[mi] = ri
+            m_of_msg[mi] = np.arange(len(run.idx))
         rejects = {r.msg_index for r in sched.host_rejects}
         barriers_by_msg = {b.msg_index: b for b in sched.barriers}
         dense = self.shards > 1
@@ -251,14 +371,16 @@ class LaneSession:
                     echo.action = op.REJECT
                 recs.append(OutRecord("OUT", echo))
             else:
-                run, mm = pos_of_msg[i]
+                run = runs[run_of_msg[i]]
+                mm = int(m_of_msg[i])
                 h = run.host
-                p = run.placements[mm]
+                row = run.idx[mm]
+                lane_act = int(cols["act"][row])
                 ok = bool(h["ok"][mm])
-                is_trade = p.lane_act in (L.L_BUY, L.L_SELL)
+                is_trade = lane_act in (L.L_BUY, L.L_SELL)
                 if is_trade and ok:
-                    sid = lane_to_sid[p.lane]
-                    is_buy = p.lane_act == L.L_BUY
+                    sid = lane_to_sid[int(cols["lane"][row])]
+                    is_buy = lane_act == L.L_BUY
                     o0 = int(run.offs[mm])
                     for e in range(int(h["nfill"][mm])):
                         if dense:
@@ -301,6 +423,10 @@ class LaneSession:
         positions = {}
         orders = {}
         S, _, N = s["slot_oid"].shape
+        for k in ("pos_amt", "pos_avail"):
+            s[k] = s[k].reshape(S, -1)  # flat (S*A,) device layout
+        # a position exists iff amt != 0 (no-used-flag invariant)
+        s["pos_used"] = s["pos_amt"] != 0
         for lane in range(S):
             sid = lane_to_sid.get(lane)
             if sid is None:

@@ -1,0 +1,72 @@
+"""Microbenchmark the COMPACT lane-engine scan step.
+
+Times a T-step compact scan at several (S, A, W, N) shapes to locate
+the per-step cost (flat position-array scatters vs sort vs replay).
+Usage: python scripts/bench_compact.py [T]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import kme_tpu._jaxsetup  # noqa: F401
+import jax
+import numpy as np
+
+from kme_tpu.engine import lanes as L
+
+
+def _force(out):
+    leaves = jax.tree.leaves(out)
+    np.asarray(leaves[0])
+    np.asarray(leaves[-1])
+
+
+def bench_shape(S, N, A, E, W, T, n=3, unroll=1):
+    """Time a T-step compact scan; returns seconds per step."""
+    cfg = L.LaneConfig(lanes=S + 1, slots=N, accounts=A, max_fills=E,
+                       steps=T, width=W, unroll=unroll)
+    state = L.make_lane_state(cfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "act": rng.integers(1, 3, (T, W)).astype(np.int32),
+        "oid": rng.integers(1, 1 << 40, (T, W)).astype(np.int64),
+        "aid": rng.integers(0, A, (T, W)).astype(np.int32),
+        "price": rng.integers(0, 126, (T, W)).astype(np.int32),
+        "size": rng.integers(1, 40, (T, W)).astype(np.int32),
+        "lane": rng.permuted(
+            np.broadcast_to(np.arange(W, dtype=np.int32) % S, (T, W)).copy(),
+            axis=1),
+    }
+    step = jax.jit(L.build_lane_step(cfg), donate_argnums=(0,))
+    state, out = step(state, batch)  # compile + warmup
+    _force(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, out = step(state, batch)
+    _force(out)
+    dt = (time.perf_counter() - t0) / n
+    return dt / T
+
+
+def main():
+    T = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    print(f"backend={jax.devices()[0].platform} T={T}", file=sys.stderr)
+    shapes = [
+        # (S, N, A, E, W, unroll) — vary one axis around the bench point
+        (1024, 128, 2048, 16, 16, 1),
+        (1024, 128, 64, 16, 16, 1),     # tiny-A control: fixed-base size
+        (1024, 128, 2048, 16, 16, 2),
+        (1024, 128, 2048, 16, 16, 4),
+        (1024, 128, 2048, 16, 16, 8),
+    ]
+    for S, N, A, E, W, U in shapes:
+        us = bench_shape(S, N, A, E, W, T, unroll=U) * 1e6
+        print(f"S={S:5d} N={N:4d} A={A:5d} E={E:3d} W={W:3d} U={U}  "
+              f"{us:8.1f} us/step", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,12 +21,15 @@ CFG = LaneConfig(lanes=8, slots=128, accounts=64, max_fills=32, steps=32)
 
 def assert_lane_parity(msgs, cfg=CFG, width=16):
     ses = LaneSession(cfg, width=width)
+    wire_ses = LaneSession(cfg, width=width)  # fast wire-line path
     ora = OracleEngine("fixed")
     got = ses.process(msgs)
+    got_wire = wire_ses.process_wire([m.copy() for m in msgs])
     for i, m in enumerate(msgs):
         want = [r.wire() for r in ora.process(m.copy())]
         g = [r.wire() for r in got[i]]
         assert g == want, f"stream diverged at message {i}: {m}"
+        assert got_wire[i] == want, f"wire path diverged at message {i}: {m}"
     exp = ses.export_state()
     assert exp["balances"] == dict(ora.balances)
     assert exp["positions"] == dict(ora.positions)
