@@ -1,0 +1,98 @@
+"""In-process broker core: ordered topic logs with offset fetch.
+
+Semantics mirror what the reference actually uses of Kafka
+(/root/reference/topic.js:14-25, exchange_test.js:14-16, consumer.js:13-17):
+- named topics created explicitly (1 partition each — the provisioner
+  pins `numPartitions: 1`, so each topic is ONE totally-ordered log);
+- producers append (key, value) string records;
+- consumers fetch by offset (fromBeginning => offset 0) and poll
+  blocking with a timeout.
+
+Thread-safe; `fetch` blocks on a condition variable until data arrives
+or the timeout lapses — the poll-loop shape of a Kafka consumer without
+the broker round-trip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+
+class BrokerError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Record:
+    offset: int
+    key: Optional[str]
+    value: str
+
+
+class _Topic:
+    def __init__(self, partitions: int = 1) -> None:
+        self.partitions = partitions
+        self.log: List[Record] = []
+
+
+class InProcessBroker:
+    """The broker API the rest of the bridge codes against. The TCP
+    client (tcp.TcpBroker) implements the same three methods."""
+
+    def __init__(self) -> None:
+        self._topics: Dict[str, _Topic] = {}
+        self._lock = threading.Lock()
+        self._data = threading.Condition(self._lock)
+
+    # -- admin ----------------------------------------------------------
+
+    def create_topic(self, name: str, partitions: int = 1) -> bool:
+        """Create a topic; False if it already exists (kafkajs
+        createTopics semantics: returns false when nothing was created)."""
+        if partitions != 1:
+            raise BrokerError("only 1 partition per topic is supported "
+                              "(the reference provisions exactly 1)")
+        with self._lock:
+            if name in self._topics:
+                return False
+            self._topics[name] = _Topic(partitions)
+            return True
+
+    def topics(self) -> Dict[str, int]:
+        with self._lock:
+            return {n: t.partitions for n, t in self._topics.items()}
+
+    # -- data path ------------------------------------------------------
+
+    def produce(self, topic: str, key: Optional[str], value: str) -> int:
+        """Append one record; returns its offset."""
+        with self._data:
+            t = self._topics.get(topic)
+            if t is None:
+                raise BrokerError(f"unknown topic {topic!r}")
+            off = len(t.log)
+            t.log.append(Record(off, key, value))
+            self._data.notify_all()
+            return off
+
+    def fetch(self, topic: str, offset: int, max_records: int = 1024,
+              timeout: float = 0.0) -> List[Record]:
+        """Records from `offset` (at most max_records). Blocks up to
+        `timeout` seconds while the log end is <= offset."""
+        with self._data:
+            t = self._topics.get(topic)
+            if t is None:
+                raise BrokerError(f"unknown topic {topic!r}")
+            if timeout > 0 and len(t.log) <= offset:
+                self._data.wait_for(lambda: len(t.log) > offset,
+                                    timeout=timeout)
+            return t.log[offset:offset + max_records]
+
+    def end_offset(self, topic: str) -> int:
+        with self._lock:
+            t = self._topics.get(topic)
+            if t is None:
+                raise BrokerError(f"unknown topic {topic!r}")
+            return len(t.log)

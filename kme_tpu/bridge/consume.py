@@ -1,0 +1,57 @@
+"""Fill-stream consumer — the consumer.js role
+(/root/reference/consumer.js:10-20): subscribe to `MatchOut` from the
+beginning and print one `<key> <value>` line per record."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from kme_tpu.bridge.service import TOPIC_OUT
+
+
+def consume_lines(broker, offset: int = 0, follow: bool = True,
+                  poll_timeout: float = 0.5, idle_exit: float = None):
+    """Yield `<key> <value>` lines from MatchOut starting at `offset`.
+    follow=False stops at the current end; idle_exit stops after that
+    many idle seconds."""
+    import time
+
+    idle_since = time.monotonic()
+    while True:
+        recs = broker.fetch(TOPIC_OUT, offset, 4096,
+                            timeout=poll_timeout if follow else 0.0)
+        if not recs:
+            if not follow:
+                return
+            if (idle_exit is not None
+                    and time.monotonic() - idle_since >= idle_exit):
+                return
+            continue
+        idle_since = time.monotonic()
+        for r in recs:
+            yield f"{r.key} {r.value}"
+        offset = recs[-1].offset + 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kme-consume", description=__doc__)
+    p.add_argument("--broker", default="127.0.0.1:9092", metavar="HOST:PORT")
+    p.add_argument("--no-follow", action="store_true",
+                   help="stop at the current end of MatchOut")
+    p.add_argument("--idle-exit", type=float, default=None, metavar="SECS",
+                   help="exit after this many seconds with no new records")
+    args = p.parse_args(argv)
+    from kme_tpu.bridge.tcp import TcpBroker, parse_addr
+
+    host, port = parse_addr(args.broker)
+    client = TcpBroker(host, port)
+    try:
+        for line in consume_lines(client, follow=not args.no_follow,
+                                  idle_exit=args.idle_exit):
+            print(line, flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        client.close()
+    return 0

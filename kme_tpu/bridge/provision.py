@@ -1,0 +1,33 @@
+"""Topic provisioner — the topic.js role (/root/reference/topic.js:14-25):
+create `MatchIn` and `MatchOut`, one partition each, against a broker."""
+
+from __future__ import annotations
+
+import argparse
+
+from kme_tpu.bridge.service import TOPIC_IN, TOPIC_OUT
+
+
+def provision(broker) -> dict:
+    """Create both topics; returns {topic: created?}."""
+    return {t: broker.create_topic(t, partitions=1)
+            for t in (TOPIC_IN, TOPIC_OUT)}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kme-provision", description=__doc__)
+    p.add_argument("--broker", default="127.0.0.1:9092",
+                   metavar="HOST:PORT",
+                   help="broker address (a running kme-serve)")
+    args = p.parse_args(argv)
+    from kme_tpu.bridge.tcp import TcpBroker, parse_addr
+
+    host, port = parse_addr(args.broker)
+    client = TcpBroker(host, port)
+    try:
+        for topic, created in provision(client).items():
+            state = "created" if created else "exists"
+            print(f"{topic}: {state} (partitions=1)")
+    finally:
+        client.close()
+    return 0

@@ -1,0 +1,62 @@
+"""Engine service — the KProcessor.main role: host the broker endpoint
+and pump MatchIn -> engine -> MatchOut.
+
+The reference splits broker (external Kafka) from engine (JVM); here
+`kme-serve` hosts both: it listens on --listen for the bridge's TCP
+broker protocol (provisioner / load generator / consumer connect there)
+and runs the MatchService poll loop in the foreground. Use
+--auto-provision to create the topics at startup (else run
+kme-provision first, as the reference README orders it)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kme-serve", description=__doc__)
+    p.add_argument("--listen", default="127.0.0.1:9092", metavar="HOST:PORT")
+    p.add_argument("--engine", choices=("lanes", "oracle"), default="lanes")
+    p.add_argument("--compat", choices=("java", "fixed"), default="fixed")
+    p.add_argument("--batch", type=int, default=1024,
+                   help="max records per engine micro-batch")
+    p.add_argument("--symbols", type=int, default=1024)
+    p.add_argument("--accounts", type=int, default=4096)
+    p.add_argument("--slots", type=int, default=128)
+    p.add_argument("--max-fills", type=int, default=16)
+    p.add_argument("--width", type=int, default=8)
+    p.add_argument("--shards", type=int, default=1)
+    p.add_argument("--strict", action="store_true",
+                   help="die on malformed input records like the "
+                        "reference's serde does (KProcessor.java:513-517)")
+    p.add_argument("--auto-provision", action="store_true")
+    p.add_argument("--max-messages", type=int, default=None)
+    p.add_argument("--idle-exit", type=float, default=None, metavar="SECS")
+    args = p.parse_args(argv)
+
+    from kme_tpu.bridge.provision import provision
+    from kme_tpu.bridge.service import MatchService
+    from kme_tpu.bridge.tcp import parse_addr, serve_broker
+
+    host, port = parse_addr(args.listen)
+    srv, broker = serve_broker(host, port)
+    real_host, real_port = srv.server_address[:2]
+    print(f"kme-serve: broker listening on {real_host}:{real_port}",
+          file=sys.stderr)
+    if args.auto_provision:
+        provision(broker)
+    svc = MatchService(broker, engine=args.engine, compat=args.compat,
+                       batch=args.batch, symbols=args.symbols,
+                       accounts=args.accounts, slots=args.slots,
+                       max_fills=args.max_fills, width=args.width,
+                       shards=args.shards, strict=args.strict)
+    try:
+        seen = svc.run(max_messages=args.max_messages,
+                       idle_exit=args.idle_exit)
+        print(f"kme-serve: processed {seen} records", file=sys.stderr)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.shutdown()
+    return 0

@@ -1,0 +1,140 @@
+"""MatchService: the engine service behind the MatchIn/MatchOut topics.
+
+The reference role: Kafka Streams pulls records from `MatchIn`, the
+processor forwards the pre-image with key "IN", processes, and forwards
+the result/fill stream with key "OUT" to `MatchOut`
+(/root/reference/src/main/java/KProcessor.java:96-126). Here the same
+contract is a poll loop over the broker API with a pluggable engine:
+
+- engine="lanes"  — the device throughput engine (fixed-mode semantics,
+  micro-batched through LaneSession.process_wire). The batch boundary
+  replaces the reference's per-record commit (KProcessor.java:125,
+  SURVEY.md §7 H5): offsets advance only after a batch's outputs are
+  produced.
+- engine="oracle" — the scalar reference replica (compat java|fixed),
+  quirk-exact per message; the slow-but-byte-faithful configuration.
+
+Malformed values (JSON Jackson would reject) kill the reference's
+stream thread (KProcessor.java:513-517); the service instead drops the
+record with a stderr note — a deliberate fix, flagged by `strict=True`
+which replicates the reference behavior by raising.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+TOPIC_IN = "MatchIn"    # topic.js:17
+TOPIC_OUT = "MatchOut"  # topic.js:21
+
+
+class MatchService:
+    def __init__(self, broker, engine: str = "lanes",
+                 compat: str = "fixed", batch: int = 1024,
+                 symbols: int = 1024, accounts: int = 4096,
+                 slots: int = 128, max_fills: int = 16,
+                 width: int = 8, shards: int = 1,
+                 strict: bool = False) -> None:
+        self.broker = broker
+        self.engine_kind = engine
+        self.batch = batch
+        self.strict = strict
+        self.offset = 0
+        if engine == "lanes":
+            if compat != "fixed":
+                raise ValueError("the lanes engine is fixed-mode only; "
+                                 "use engine='oracle' for compat='java'")
+            from kme_tpu.engine.lanes import LaneConfig
+            from kme_tpu.runtime.session import LaneSession
+
+            cfg = LaneConfig(lanes=symbols, slots=slots, accounts=accounts,
+                             max_fills=max_fills)
+            self._session = LaneSession(cfg, shards=shards, width=width)
+            self._oracle = None
+        elif engine == "oracle":
+            from kme_tpu.oracle import OracleEngine
+
+            self._session = None
+            # the capacity envelope is a fixed-mode concept; java compat
+            # replicates the reference's unbounded stores
+            kw = ({"book_slots": slots, "max_fills": max_fills}
+                  if compat == "fixed" else {})
+            self._oracle = OracleEngine(compat, **kw)
+        else:
+            raise ValueError(f"unknown engine {engine!r}")
+
+    # ------------------------------------------------------------------
+
+    def _parse(self, value: str):
+        from kme_tpu.wire import parse_order
+
+        try:
+            return parse_order(value)
+        except ValueError:
+            if self.strict:
+                raise
+            print(f"kme-serve: dropping malformed record: {value[:120]!r}",
+                  file=sys.stderr)
+            return None
+
+    def step(self, timeout: float = 0.5) -> int:
+        """Poll once: fetch up to `batch` records, process, produce the
+        record stream. Returns the number of input records consumed."""
+        from kme_tpu.bridge.broker import BrokerError
+
+        try:
+            recs = self.broker.fetch(TOPIC_IN, self.offset, self.batch,
+                                     timeout=timeout)
+        except BrokerError:
+            # topics not provisioned yet — keep polling, like a Streams
+            # app waiting for its source topic
+            import time
+
+            time.sleep(min(timeout, 0.05))
+            return 0
+        if not recs:
+            return 0
+        msgs, keep = [], []
+        for r in recs:
+            m = self._parse(r.value)
+            if m is not None:
+                msgs.append(m)
+                keep.append(r.offset)
+        if msgs:
+            if self._session is not None:
+                for lines in self._session.process_wire(msgs):
+                    for ln in lines:
+                        key, _, value = ln.partition(" ")
+                        self.broker.produce(TOPIC_OUT, key, value)
+            else:
+                for m in msgs:
+                    for rec in self._oracle.process(m):
+                        from kme_tpu.wire import dumps_order
+
+                        self.broker.produce(TOPIC_OUT, rec.key,
+                                            dumps_order(rec.value))
+        # batch-boundary commit (H5): offsets advance only after the
+        # outputs for the whole batch are on MatchOut
+        self.offset = recs[-1].offset + 1
+        return len(recs)
+
+    def run(self, max_messages: Optional[int] = None,
+            idle_exit: Optional[float] = None,
+            poll_timeout: float = 0.5) -> int:
+        """Serve until max_messages consumed (None = forever) or the
+        input topic stays idle for `idle_exit` seconds."""
+        import time
+
+        seen = 0
+        idle_since = time.monotonic()
+        while max_messages is None or seen < max_messages:
+            n = self.step(timeout=poll_timeout)
+            now = time.monotonic()
+            if n == 0:
+                if idle_exit is not None and now - idle_since >= idle_exit:
+                    break
+            else:
+                idle_since = now
+                seen += n
+        return seen

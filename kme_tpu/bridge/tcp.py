@@ -1,0 +1,155 @@
+"""TCP process boundary for the broker: JSON-lines request/response.
+
+The reference's clients cross a process boundary to the broker over the
+Kafka wire protocol (kafkajs in Node, kafka-clients on the JVM). The
+equivalent here is a deliberately small framed protocol — one JSON
+object per line — carrying the three broker operations:
+
+  {"op":"create_topic","topic":T,"partitions":1}  -> {"ok":true,"created":b}
+  {"op":"topics"}                                 -> {"ok":true,"topics":{...}}
+  {"op":"produce","topic":T,"key":K,"value":V}    -> {"ok":true,"offset":N}
+  {"op":"fetch","topic":T,"offset":N,"max":M,
+   "timeout_ms":W}                                -> {"ok":true,
+                                                     "records":[[o,k,v],...]}
+  {"op":"end_offset","topic":T}                   -> {"ok":true,"offset":N}
+
+Errors come back as {"ok":false,"error":"..."}; the client raises
+BrokerError. `serve_broker` hosts an InProcessBroker for any number of
+concurrent client connections (thread per connection — the broker core
+is already thread-safe).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import List, Optional
+
+from kme_tpu.bridge.broker import BrokerError, InProcessBroker, Record
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        broker: InProcessBroker = self.server.broker  # type: ignore
+        for raw in self.rfile:
+            try:
+                req = json.loads(raw)
+                op = req.get("op")
+                if op == "create_topic":
+                    created = broker.create_topic(
+                        req["topic"], int(req.get("partitions", 1)))
+                    resp = {"ok": True, "created": created}
+                elif op == "topics":
+                    resp = {"ok": True, "topics": broker.topics()}
+                elif op == "produce":
+                    off = broker.produce(req["topic"], req.get("key"),
+                                         req["value"])
+                    resp = {"ok": True, "offset": off}
+                elif op == "produce_batch":
+                    # one round trip for a whole record batch — the bulk
+                    # seeding path (kme-loadgen)
+                    off = -1
+                    for key, value in req["records"]:
+                        off = broker.produce(req["topic"], key, value)
+                    resp = {"ok": True, "last_offset": off}
+                elif op == "fetch":
+                    recs = broker.fetch(
+                        req["topic"], int(req["offset"]),
+                        int(req.get("max", 1024)),
+                        float(req.get("timeout_ms", 0)) / 1e3)
+                    resp = {"ok": True,
+                            "records": [[r.offset, r.key, r.value]
+                                        for r in recs]}
+                elif op == "end_offset":
+                    resp = {"ok": True,
+                            "offset": broker.end_offset(req["topic"])}
+                else:
+                    resp = {"ok": False, "error": f"unknown op {op!r}"}
+            except BrokerError as e:
+                resp = {"ok": False, "error": str(e)}
+            except (KeyError, ValueError, TypeError) as e:
+                resp = {"ok": False, "error": f"bad request: {e}"}
+            try:
+                self.wfile.write(
+                    (json.dumps(resp, separators=(",", ":")) + "\n").encode())
+            except (BrokenPipeError, ConnectionResetError):
+                return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve_broker(host: str = "127.0.0.1", port: int = 9092,
+                 broker: Optional[InProcessBroker] = None):
+    """Start serving `broker` on (host, port) in a daemon thread.
+    Returns (server, broker); server.shutdown() stops it. port=0 picks a
+    free port (server.server_address has the real one)."""
+    broker = broker or InProcessBroker()
+    srv = _Server((host, port), _Handler)
+    srv.broker = broker  # type: ignore
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, broker
+
+
+class TcpBroker:
+    """Client with the InProcessBroker API over the line protocol."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._rfile = self._sock.makefile("rb")
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        finally:
+            self._sock.close()
+
+    def _call(self, req: dict) -> dict:
+        with self._lock:
+            self._sock.sendall(
+                (json.dumps(req, separators=(",", ":")) + "\n").encode())
+            raw = self._rfile.readline()
+        if not raw:
+            raise BrokerError("broker connection closed")
+        resp = json.loads(raw)
+        if not resp.get("ok"):
+            raise BrokerError(resp.get("error", "unknown broker error"))
+        return resp
+
+    def create_topic(self, name: str, partitions: int = 1) -> bool:
+        return self._call({"op": "create_topic", "topic": name,
+                           "partitions": partitions})["created"]
+
+    def topics(self) -> dict:
+        return self._call({"op": "topics"})["topics"]
+
+    def produce(self, topic: str, key: Optional[str], value: str) -> int:
+        return self._call({"op": "produce", "topic": topic, "key": key,
+                           "value": value})["offset"]
+
+    def produce_batch(self, topic: str, records) -> int:
+        """Append [(key, value), ...] in one round trip; returns the last
+        offset (-1 for an empty batch)."""
+        return self._call({"op": "produce_batch", "topic": topic,
+                           "records": list(records)})["last_offset"]
+
+    def fetch(self, topic: str, offset: int, max_records: int = 1024,
+              timeout: float = 0.0) -> List[Record]:
+        resp = self._call({"op": "fetch", "topic": topic, "offset": offset,
+                           "max": max_records, "timeout_ms": timeout * 1e3})
+        return [Record(o, k, v) for o, k, v in resp["records"]]
+
+    def end_offset(self, topic: str) -> int:
+        return self._call({"op": "end_offset", "topic": topic})["offset"]
+
+
+def parse_addr(addr: str) -> tuple:
+    """'host:port' -> (host, port) (the broker address CLI flag)."""
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)

@@ -32,15 +32,36 @@ def loadgen_main(argv=None) -> int:
     p.add_argument("--fix-payout-opcode", action="store_true",
                    help="emit real PAYOUT (200) instead of the reference "
                         "harness's action=4 bug (Q5)")
+    p.add_argument("--broker", default=None, metavar="HOST:PORT",
+                   help="produce to MatchIn on this broker instead of "
+                        "printing to stdout (the exchange_test.js role)")
     args = p.parse_args(argv)
     from kme_tpu.wire import dumps_order
     from kme_tpu.workload import harness_stream
 
-    for m in harness_stream(args.events, seed=args.seed,
-                            num_accounts=args.accounts,
-                            num_symbols=args.symbols,
-                            payout_opcode_bug=not args.fix_payout_opcode,
-                            validate=args.validate):
+    msgs = harness_stream(args.events, seed=args.seed,
+                          num_accounts=args.accounts,
+                          num_symbols=args.symbols,
+                          payout_opcode_bug=not args.fix_payout_opcode,
+                          validate=args.validate)
+    if args.broker is not None:
+        from kme_tpu.bridge.service import TOPIC_IN
+        from kme_tpu.bridge.tcp import TcpBroker, parse_addr
+
+        host, port = parse_addr(args.broker)
+        client = TcpBroker(host, port)
+        try:
+            client.create_topic(TOPIC_IN)  # idempotent self-provision
+            for lo in range(0, len(msgs), 4096):
+                client.produce_batch(
+                    TOPIC_IN, [(None, dumps_order(m))
+                               for m in msgs[lo:lo + 4096]])
+        finally:
+            client.close()
+        print(f"kme-loadgen: produced {len(msgs)} records to MatchIn",
+              file=sys.stderr)
+        return 0
+    for m in msgs:
         print(dumps_order(m))
     return 0
 

@@ -98,11 +98,18 @@ class Schedule:
     segment_steps: List[int]
     program: List[tuple]  # ("scan", seg_idx) | ("barrier", barrier_idx)
 
+    _placements_cache: Optional[List[Placed]] = None
+
     @property
     def placements(self) -> List[Placed]:
-        c = self.cols
-        return [Placed(*(int(c[name][i]) for name, _ in _COL_DTYPES))
+        """Row-object view of `cols` (tests/debugging; O(n) to build,
+        cached on first access)."""
+        if self._placements_cache is None:
+            c = self.cols
+            self._placements_cache = [
+                Placed(*(int(c[name][i]) for name, _ in _COL_DTYPES))
                 for i in range(len(c["msg_index"]))]
+        return self._placements_cache
 
 
 _TRADE_ACTS = {op.BUY: L.L_BUY, op.SELL: L.L_SELL}
