@@ -30,6 +30,12 @@ def main(argv=None) -> int:
     p.add_argument("--strict", action="store_true",
                    help="die on malformed input records like the "
                         "reference's serde does (KProcessor.java:513-517)")
+    p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                   help="snapshot engine state + input offset here at "
+                        "batch boundaries; resume from the newest valid "
+                        "snapshot at startup (at-least-once replay)")
+    p.add_argument("--checkpoint-every", type=int, default=4096,
+                   metavar="N", help="records between snapshots")
     p.add_argument("--auto-provision", action="store_true")
     p.add_argument("--max-messages", type=int, default=None)
     p.add_argument("--idle-exit", type=float, default=None, metavar="SECS")
@@ -50,10 +56,14 @@ def main(argv=None) -> int:
                        batch=args.batch, symbols=args.symbols,
                        accounts=args.accounts, slots=args.slots,
                        max_fills=args.max_fills, width=args.width,
-                       shards=args.shards, strict=args.strict)
+                       shards=args.shards, strict=args.strict,
+                       checkpoint_dir=args.checkpoint_dir,
+                       checkpoint_every=args.checkpoint_every)
     try:
         seen = svc.run(max_messages=args.max_messages,
                        idle_exit=args.idle_exit)
+        if args.checkpoint_dir is not None:
+            svc.checkpoint()
         print(f"kme-serve: processed {seen} records", file=sys.stderr)
     except KeyboardInterrupt:
         pass

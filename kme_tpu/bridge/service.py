@@ -35,16 +35,28 @@ class MatchService:
                  symbols: int = 1024, accounts: int = 4096,
                  slots: int = 128, max_fills: int = 16,
                  width: int = 8, shards: int = 1,
-                 strict: bool = False) -> None:
+                 strict: bool = False,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 4096) -> None:
+        if engine not in ("lanes", "oracle"):
+            raise ValueError(f"unknown engine {engine!r}")
+        if engine == "lanes" and compat != "fixed":
+            raise ValueError("the lanes engine is fixed-mode only; "
+                             "use engine='oracle' for compat='java'")
         self.broker = broker
         self.engine_kind = engine
         self.batch = batch
         self.strict = strict
         self.offset = 0
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        self._last_ckpt_offset = 0
+        resumed = False
+        if checkpoint_dir is not None:
+            resumed = self._try_resume(engine, compat, shards, width)
+        if resumed:
+            return
         if engine == "lanes":
-            if compat != "fixed":
-                raise ValueError("the lanes engine is fixed-mode only; "
-                                 "use engine='oracle' for compat='java'")
             from kme_tpu.engine.lanes import LaneConfig
             from kme_tpu.runtime.session import LaneSession
 
@@ -63,6 +75,56 @@ class MatchService:
             self._oracle = OracleEngine(compat, **kw)
         else:
             raise ValueError(f"unknown engine {engine!r}")
+
+    # ------------------------------------------------------------------
+    # durability: snapshot at batch boundaries, resume = load + replay
+    # the MatchIn tail from the snapshot offset (at-least-once, like the
+    # reference with exactly-once commented out — KProcessor.java:29)
+
+    def _try_resume(self, engine: str, compat: str, shards: int,
+                    width: int) -> bool:
+        from kme_tpu.runtime import checkpoint as ck
+
+        if engine == "lanes":
+            # elastic restore onto the REQUESTED topology (snapshots are
+            # canonical across shards/width)
+            ses, offset = ck.load_session(self.checkpoint_dir,
+                                          shards=shards, width=width)
+            if ses is None:
+                return False
+            self._session, self._oracle = ses, None
+        else:
+            ora, offset = ck.load_oracle(self.checkpoint_dir)
+            if ora is None:
+                return False
+            snap_compat = "java" if ora.java else "fixed"
+            if snap_compat != compat:
+                raise ValueError(
+                    f"snapshot in {self.checkpoint_dir} was taken with "
+                    f"compat={snap_compat!r}, but compat={compat!r} was "
+                    f"requested")
+            self._session, self._oracle = None, ora
+        self.offset = self._last_ckpt_offset = offset
+        print(f"kme-serve: resumed from snapshot at offset {offset}",
+              file=sys.stderr)
+        return True
+
+    def _maybe_checkpoint(self) -> None:
+        if self.checkpoint_dir is None:
+            return
+        if self.offset - self._last_ckpt_offset < self.checkpoint_every:
+            return
+        self.checkpoint()
+
+    def checkpoint(self) -> None:
+        """Snapshot engine state + input offset (batch boundary)."""
+        from kme_tpu.runtime import checkpoint as ck
+
+        if self._session is not None:
+            ck.save_session(self.checkpoint_dir, self._session, self.offset)
+        else:
+            ck.save_oracle(self.checkpoint_dir, self._oracle, self.offset)
+        self._last_ckpt_offset = self.offset
 
     # ------------------------------------------------------------------
 
@@ -95,12 +157,11 @@ class MatchService:
             return 0
         if not recs:
             return 0
-        msgs, keep = [], []
+        msgs = []
         for r in recs:
             m = self._parse(r.value)
             if m is not None:
                 msgs.append(m)
-                keep.append(r.offset)
         if msgs:
             if self._session is not None:
                 for lines in self._session.process_wire(msgs):
@@ -108,15 +169,16 @@ class MatchService:
                         key, _, value = ln.partition(" ")
                         self.broker.produce(TOPIC_OUT, key, value)
             else:
+                from kme_tpu.wire import dumps_order
+
                 for m in msgs:
                     for rec in self._oracle.process(m):
-                        from kme_tpu.wire import dumps_order
-
                         self.broker.produce(TOPIC_OUT, rec.key,
                                             dumps_order(rec.value))
         # batch-boundary commit (H5): offsets advance only after the
         # outputs for the whole batch are on MatchOut
         self.offset = recs[-1].offset + 1
+        self._maybe_checkpoint()
         return len(recs)
 
     def run(self, max_messages: Optional[int] = None,

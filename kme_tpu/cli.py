@@ -45,13 +45,14 @@ def loadgen_main(argv=None) -> int:
                           payout_opcode_bug=not args.fix_payout_opcode,
                           validate=args.validate)
     if args.broker is not None:
+        from kme_tpu.bridge.provision import provision
         from kme_tpu.bridge.service import TOPIC_IN
         from kme_tpu.bridge.tcp import TcpBroker, parse_addr
 
         host, port = parse_addr(args.broker)
         client = TcpBroker(host, port)
         try:
-            client.create_topic(TOPIC_IN)  # idempotent self-provision
+            provision(client)  # idempotent: both topics must exist
             for lo in range(0, len(msgs), 4096):
                 client.produce_batch(
                     TOPIC_IN, [(None, dumps_order(m))

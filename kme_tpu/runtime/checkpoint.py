@@ -1,0 +1,224 @@
+"""Checkpoint / resume: the durability story.
+
+The reference gets durability from RocksDB-backed stores + Kafka
+changelog topics; resume = Kafka Streams restoring store state and
+continuing from the committed input offset
+(/root/reference/src/main/java/KProcessor.java:30-49; commit :125).
+Exactly-once is commented out (:29), so its guarantee is AT-LEAST-ONCE:
+on crash, records after the last commit replay.
+
+The TPU-native equivalent: an explicit `(state_pytree, input_offset)`
+snapshot at a batch boundary (SURVEY.md §5). Because the engine is
+deterministic, resume = load snapshot + replay the input tail, and the
+replayed outputs are bit-identical — the same at-least-once contract
+with replay bounded by the checkpoint interval instead of one record.
+
+Snapshots are self-describing single files: every state array plus a
+JSON `meta` blob (config, compaction width, shard count, input offset,
+scheduler id-maps) in one .npz, written atomically (tmp + rename) and
+named ckpt-<offset>.npz so the latest valid one wins; a torn or corrupt
+file falls back to the previous snapshot.
+
+The device fill log is intentionally NOT saved: at a batch boundary it
+has been drained to the host and rewound (filloff == 0), so restore
+recreates it as zeros.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+import re
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_CKPT_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+_SKIP_KEYS = ("fillbuf",)
+# arrays whose leading axis is the lane axis (stored in CANONICAL form:
+# user lanes only — the compact path's scrap row is provably all-zero,
+# so it is stripped at save and recreated at load; this makes snapshots
+# portable across width/shard configurations)
+_LANE_KEYS = ("slot_oid", "slot_aid", "slot_price", "slot_size",
+              "slot_seq", "slot_used", "seq", "book_exists")
+_POS_KEYS = ("pos_amt", "pos_avail")  # flat (S*A,) lane-major
+
+
+def snapshot_path(ckpt_dir: str, offset: int) -> str:
+    return os.path.join(ckpt_dir, f"ckpt-{offset}.npz")
+
+
+def list_snapshots(ckpt_dir: str) -> List[Tuple[int, str]]:
+    """(offset, path) pairs, newest first."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def save_session(ckpt_dir: str, session, offset: int) -> str:
+    """Snapshot `session` (a LaneSession) at input offset `offset`.
+    Must be called at a batch boundary (the fill log drained)."""
+    import jax
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    state = jax.tree.map(np.asarray, session.state)
+    if int(state["filloff"][0]) != 0:
+        raise ValueError("snapshot requires a drained fill log "
+                         "(call at a batch boundary)")
+    sch = session.scheduler
+    meta = {
+        "version": 1,
+        "kind": "lanes",
+        "offset": int(offset),
+        "cfg": dataclasses.asdict(session.cfg),
+        "width": int(session.dev_cfg.width),
+        "shards": int(session.shards),
+        "aid_idx": sorted(sch.aid_idx.items()),
+        "sid_lane": sorted(sch.sid_lane.items()),
+        "oid_sid": sorted(sch.oid_sid.items()),
+        "rr_lane": sch._rr_lane,
+    }
+    S = session.cfg.lanes  # canonical lane count (no scrap row)
+    A = session.cfg.accounts
+    payload = {}
+    for k, v in state.items():
+        if k in _SKIP_KEYS:
+            continue
+        if k in _LANE_KEYS:
+            v = v[:S]
+        elif k in _POS_KEYS:
+            v = v[:S * A]
+        payload[k] = v
+    payload["meta"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    path = snapshot_path(ckpt_dir, offset)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def _load_file(path: str):
+    data = np.load(path)
+    meta = json.loads(bytes(data["meta"]).decode())
+    if meta.get("version") != 1 or meta.get("kind") != "lanes":
+        raise ValueError(f"unsupported snapshot {path}")
+    return data, meta
+
+
+def load_session(ckpt_dir: str, shards: Optional[int] = None,
+                 width: Optional[int] = None):
+    """Restore the newest valid snapshot in `ckpt_dir`.
+    Returns (session, offset) or (None, 0) when no usable snapshot
+    exists. A corrupt newest file (torn write) falls back to the next.
+    `shards`/`width` override the snapshot's values (elastic restore
+    onto a different mesh or compaction width — snapshots are canonical,
+    so any combination restores bit-exactly)."""
+    import jax.numpy as jnp
+
+    from kme_tpu.engine.lanes import LaneConfig, make_lane_state
+    from kme_tpu.runtime.session import LaneSession
+
+    for offset, path in list_snapshots(ckpt_dir):
+        try:
+            data, meta = _load_file(path)
+        except Exception as e:  # torn/corrupt snapshot: fall back
+            import sys
+
+            print(f"kme_tpu.checkpoint: skipping unreadable snapshot "
+                  f"{path}: {e}", file=sys.stderr)
+            continue
+        cfg = LaneConfig(**meta["cfg"])
+        use_shards = meta["shards"] if shards is None else shards
+        use_width = meta["width"] if width is None else width
+        ses = LaneSession(cfg, shards=use_shards, width=use_width or 0)
+        fresh = make_lane_state(ses.dev_cfg)
+        S, A = cfg.lanes, cfg.accounts
+        state = {}
+        for k, v in fresh.items():
+            if k in _SKIP_KEYS:
+                state[k] = v  # recreated empty (drained at snapshot)
+                continue
+            arr = np.asarray(data[k])
+            if k in _LANE_KEYS or k in _POS_KEYS:
+                n = S if k in _LANE_KEYS else S * A
+                if arr.shape[:1] != (n,) or arr.shape[1:] != v.shape[1:]:
+                    raise ValueError(
+                        f"snapshot {path}: shape mismatch for {k}: "
+                        f"{arr.shape} vs canonical ({n},)+{v.shape[1:]}")
+                full = np.array(v)  # writable zeros incl. scrap row
+                full[:n] = arr
+                state[k] = jnp.asarray(full)
+            else:
+                if arr.shape != tuple(v.shape):
+                    raise ValueError(
+                        f"snapshot {path}: shape mismatch for {k}: "
+                        f"{arr.shape} vs {tuple(v.shape)}")
+                state[k] = jnp.asarray(arr)
+        if use_shards > 1:
+            from kme_tpu.parallel import mesh as M
+
+            state = M.shard_state(state, ses.mesh)
+        ses.state = state
+        sch = ses.scheduler
+        sch.aid_idx = {int(k): int(i) for k, i in meta["aid_idx"]}
+        sch.sid_lane = {int(k): int(l) for k, l in meta["sid_lane"]}
+        sch.oid_sid = {int(k): int(s) for k, s in meta["oid_sid"]}
+        sch._rr_lane = int(meta["rr_lane"])
+        return ses, offset
+    return None, 0
+
+
+# ---------------------------------------------------------------------------
+# oracle-engine snapshots (the scalar replica is plain host state)
+
+def save_oracle(ckpt_dir: str, oracle, offset: int) -> str:
+    import pickle
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"ckpt-{offset}.pkl")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump({"version": 1, "kind": "oracle", "offset": int(offset),
+                     "engine": oracle}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load_oracle(ckpt_dir: str):
+    """Returns (oracle, offset) or (None, 0)."""
+    import pickle
+    import sys
+
+    if not os.path.isdir(ckpt_dir):
+        return None, 0
+    cands = []
+    for name in os.listdir(ckpt_dir):
+        m = re.match(r"^ckpt-(\d+)\.pkl$", name)
+        if m:
+            cands.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
+    cands.sort(reverse=True)
+    for offset, path in cands:
+        try:
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
+            if blob.get("version") != 1 or blob.get("kind") != "oracle":
+                raise ValueError("unsupported snapshot")
+            return blob["engine"], offset
+        except Exception as e:
+            print(f"kme_tpu.checkpoint: skipping unreadable snapshot "
+                  f"{path}: {e}", file=sys.stderr)
+    return None, 0

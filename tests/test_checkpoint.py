@@ -1,0 +1,138 @@
+"""Checkpoint / resume + fault injection.
+
+The durability contract (SURVEY.md §5, replacing the reference's
+RocksDB+changelog restore, KProcessor.java:30-49): kill the engine
+mid-stream, resume from the snapshot, and the continuation is
+bit-identical to an uninterrupted run — with at-least-once replay of
+the tail after the last snapshot, exactly like the reference (EOS is
+commented out at KProcessor.java:29).
+"""
+
+import os
+
+import pytest
+
+from kme_tpu.bridge.broker import InProcessBroker
+from kme_tpu.bridge.consume import consume_lines
+from kme_tpu.bridge.provision import provision
+from kme_tpu.bridge.service import TOPIC_IN, MatchService
+from kme_tpu.engine.lanes import LaneConfig
+from kme_tpu.oracle import OracleEngine
+from kme_tpu.runtime import checkpoint as ck
+from kme_tpu.runtime.session import LaneSession
+from kme_tpu.wire import dumps_order
+from kme_tpu.workload import harness_stream, zipf_symbol_stream
+
+CFG = LaneConfig(lanes=8, slots=64, accounts=32, max_fills=32, steps=16)
+
+
+def _stream(n=600, seed=21):
+    return zipf_symbol_stream(n, num_symbols=8, num_accounts=24, seed=seed,
+                              zipf_a=1.0)
+
+
+def test_session_kill_resume_bit_identical(tmp_path):
+    """Kill the session after 300 of 600 messages; the resumed session's
+    tail output and final state match the uninterrupted run exactly."""
+    msgs = _stream()
+    cut = 300
+
+    full = LaneSession(CFG)
+    want_lines = full.process_wire([m.copy() for m in msgs[:cut]])
+    want_lines += full.process_wire([m.copy() for m in msgs[cut:]])
+    want_state = full.export_state()
+
+    ses = LaneSession(CFG)
+    got_head = ses.process_wire([m.copy() for m in msgs[:cut]])
+    ck.save_session(str(tmp_path), ses, offset=cut)
+    del ses  # the crash
+
+    resumed, offset = ck.load_session(str(tmp_path))
+    assert offset == cut
+    got_tail = resumed.process_wire([m.copy() for m in msgs[cut:]])
+    assert got_head + got_tail == want_lines
+    assert resumed.export_state() == want_state
+
+
+def test_session_resume_across_width_configs(tmp_path):
+    """Snapshots are canonical: a compact-width session's snapshot
+    restores into a full-width session (and vice versa) bit-exactly."""
+    msgs = _stream(400, seed=4)
+    cut = 200
+
+    full = LaneSession(CFG, width=0)
+    want = full.process_wire([m.copy() for m in msgs])
+
+    a = LaneSession(CFG, width=16)
+    head = a.process_wire([m.copy() for m in msgs[:cut]])
+    ck.save_session(str(tmp_path), a, offset=cut)
+    _, meta = ck._load_file(ck.snapshot_path(str(tmp_path), cut))
+    assert meta["width"] == 8  # clamped to cfg.lanes
+
+    # restore the compact snapshot into a FULL-WIDTH session
+    b, offset = ck.load_session(str(tmp_path), width=0)
+    assert offset == cut and b.dev_cfg.width == 0
+    tail = b.process_wire([m.copy() for m in msgs[cut:]])
+    assert head + tail == want
+
+
+def test_corrupt_latest_snapshot_falls_back(tmp_path):
+    msgs = _stream(300, seed=9)
+    ses = LaneSession(CFG)
+    ses.process_wire([m.copy() for m in msgs[:100]])
+    ck.save_session(str(tmp_path), ses, offset=100)
+    ses.process_wire([m.copy() for m in msgs[100:200]])
+    ck.save_session(str(tmp_path), ses, offset=200)
+    # torn write of the newest snapshot
+    with open(ck.snapshot_path(str(tmp_path), 200), "r+b") as f:
+        f.truncate(100)
+    resumed, offset = ck.load_session(str(tmp_path))
+    assert offset == 100  # fell back to the previous good snapshot
+    assert resumed is not None
+
+
+def test_snapshot_requires_drained_fill_log(tmp_path):
+    ses = LaneSession(CFG)
+    ses.process_wire([m.copy() for m in _stream(50, seed=2)])
+    import jax.numpy as jnp
+
+    ses.state = dict(ses.state)
+    ses.state["filloff"] = jnp.ones((1,), jnp.int64)
+    with pytest.raises(ValueError, match="drained fill log"):
+        ck.save_session(str(tmp_path), ses, offset=50)
+
+
+def test_service_crash_resume_at_least_once(tmp_path):
+    """Service-level fault injection: crash a checkpointing service
+    mid-stream (after its last snapshot), restart it on the same broker
+    and checkpoint dir. The tail after the snapshot replays (at-least-
+    once) and every replayed record's output is bit-identical."""
+    msgs = harness_stream(400, seed=13, num_symbols=4, num_accounts=8,
+                          payout_opcode_bug=False, validate=True)
+    per_msg = []
+    ora = OracleEngine("fixed", book_slots=64, max_fills=32)
+    for m in msgs:
+        per_msg.append([r.wire() for r in ora.process(m.copy())])
+
+    broker = InProcessBroker()
+    provision(broker)
+    for m in msgs:
+        broker.produce(TOPIC_IN, None, dumps_order(m))
+
+    kw = dict(engine="lanes", compat="fixed", batch=50, symbols=8,
+              accounts=16, slots=64, max_fills=32,
+              checkpoint_dir=str(tmp_path), checkpoint_every=100)
+    svc = MatchService(broker, **kw)
+    assert svc.run(max_messages=250) == 250  # snapshots at 100, 200
+    assert svc._last_ckpt_offset == 200
+    del svc  # crash: 50 records past the last snapshot
+
+    svc2 = MatchService(broker, **kw)
+    assert svc2.offset == 200  # resumed
+    rest = len(msgs) - 200  # replays 200..end (at-least-once tail)
+    assert svc2.run(max_messages=rest) == rest
+
+    got = list(consume_lines(broker, follow=False))
+    want = [ln for lines in per_msg[:250] for ln in lines]
+    want += [ln for lines in per_msg[200:] for ln in lines]
+    assert got == want
