@@ -11,13 +11,22 @@ Semantics mirror what the reference actually uses of Kafka
 Thread-safe; `fetch` blocks on a condition variable until data arrives
 or the timeout lapses — the poll-loop shape of a Kafka consumer without
 the broker round-trip.
+
+`persist_dir` makes the logs DURABLE: each topic appends to an
+append-only JSONL file and the broker reloads every topic at startup —
+the Kafka-retains-the-log property the engine's checkpoint/resume
+contract depends on (the restored MatchIn offset must still address the
+same records after a broker restart). A torn trailing line (crash mid-
+append) is dropped on reload.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, IO, List, Optional
 
 
 class BrokerError(RuntimeError):
@@ -32,19 +41,51 @@ class Record:
 
 
 class _Topic:
-    def __init__(self, partitions: int = 1) -> None:
+    def __init__(self, partitions: int = 1,
+                 logfile: Optional[IO] = None) -> None:
         self.partitions = partitions
         self.log: List[Record] = []
+        self.logfile = logfile
 
 
 class InProcessBroker:
     """The broker API the rest of the bridge codes against. The TCP
     client (tcp.TcpBroker) implements the same three methods."""
 
-    def __init__(self) -> None:
+    def __init__(self, persist_dir: Optional[str] = None) -> None:
         self._topics: Dict[str, _Topic] = {}
         self._lock = threading.Lock()
         self._data = threading.Condition(self._lock)
+        self._persist_dir = persist_dir
+        if persist_dir is not None:
+            os.makedirs(persist_dir, exist_ok=True)
+            for name in sorted(os.listdir(persist_dir)):
+                if name.endswith(".log"):
+                    self._load_topic(name[:-4])
+
+    # -- durability -----------------------------------------------------
+
+    def _log_path(self, name: str) -> str:
+        return os.path.join(self._persist_dir, f"{name}.log")
+
+    def _load_topic(self, name: str) -> None:
+        topic = _Topic()
+        with open(self._log_path(name), "r", encoding="utf-8") as f:
+            for raw in f:
+                if not raw.endswith("\n"):
+                    break  # torn trailing append from a crash: drop it
+                try:
+                    key, value = json.loads(raw)
+                except ValueError:
+                    break
+                topic.log.append(Record(len(topic.log), key, value))
+        # re-write dropped torn tail, then append from there
+        with open(self._log_path(name), "w", encoding="utf-8") as f:
+            for r in topic.log:
+                f.write(json.dumps([r.key, r.value],
+                                   separators=(",", ":")) + "\n")
+        topic.logfile = open(self._log_path(name), "a", encoding="utf-8")
+        self._topics[name] = topic
 
     # -- admin ----------------------------------------------------------
 
@@ -54,10 +95,15 @@ class InProcessBroker:
         if partitions != 1:
             raise BrokerError("only 1 partition per topic is supported "
                               "(the reference provisions exactly 1)")
+        if "/" in name or name.startswith("."):
+            raise BrokerError(f"invalid topic name {name!r}")
         with self._lock:
             if name in self._topics:
                 return False
-            self._topics[name] = _Topic(partitions)
+            logfile = None
+            if self._persist_dir is not None:
+                logfile = open(self._log_path(name), "a", encoding="utf-8")
+            self._topics[name] = _Topic(partitions, logfile)
             return True
 
     def topics(self) -> Dict[str, int]:
@@ -74,6 +120,10 @@ class InProcessBroker:
                 raise BrokerError(f"unknown topic {topic!r}")
             off = len(t.log)
             t.log.append(Record(off, key, value))
+            if t.logfile is not None:
+                t.logfile.write(json.dumps([key, value],
+                                           separators=(",", ":")) + "\n")
+                t.logfile.flush()
             self._data.notify_all()
             return off
 

@@ -36,17 +36,30 @@ def main(argv=None) -> int:
                         "snapshot at startup (at-least-once replay)")
     p.add_argument("--checkpoint-every", type=int, default=4096,
                    metavar="N", help="records between snapshots")
+    p.add_argument("--log-dir", default=None, metavar="DIR",
+                   help="persist topic logs here (append-only JSONL) so "
+                        "the broker survives restarts; defaults to "
+                        "<checkpoint-dir>/broker-log when checkpointing "
+                        "is on — the restored input offset must address "
+                        "the same MatchIn records after a restart")
     p.add_argument("--auto-provision", action="store_true")
     p.add_argument("--max-messages", type=int, default=None)
     p.add_argument("--idle-exit", type=float, default=None, metavar="SECS")
     args = p.parse_args(argv)
 
+    import os
+
+    from kme_tpu.bridge.broker import InProcessBroker
     from kme_tpu.bridge.provision import provision
     from kme_tpu.bridge.service import MatchService
     from kme_tpu.bridge.tcp import parse_addr, serve_broker
 
+    log_dir = args.log_dir
+    if log_dir is None and args.checkpoint_dir is not None:
+        log_dir = os.path.join(args.checkpoint_dir, "broker-log")
+    broker = InProcessBroker(persist_dir=log_dir)
     host, port = parse_addr(args.listen)
-    srv, broker = serve_broker(host, port)
+    srv, broker = serve_broker(host, port, broker)
     real_host, real_port = srv.server_address[:2]
     print(f"kme-serve: broker listening on {real_host}:{real_port}",
           file=sys.stderr)

@@ -51,6 +51,8 @@ class MatchService:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
         self._last_ckpt_offset = 0
+        self._req_symbols, self._req_accounts = symbols, accounts
+        self._req_slots, self._req_max_fills = slots, max_fills
         resumed = False
         if checkpoint_dir is not None:
             resumed = self._try_resume(engine, compat, shards, width)
@@ -92,6 +94,14 @@ class MatchService:
                                           shards=shards, width=width)
             if ses is None:
                 return False
+            want = {"lanes": self._req_symbols, "accounts": self._req_accounts,
+                    "slots": self._req_slots, "max_fills": self._req_max_fills}
+            have = {k: getattr(ses.cfg, k) for k in want}
+            if want != have:
+                raise ValueError(
+                    f"snapshot in {self.checkpoint_dir} has capacity "
+                    f"config {have}, but {want} was requested — capacity "
+                    f"changes need a state migration, not a resume")
             self._session, self._oracle = ses, None
         else:
             ora, offset = ck.load_oracle(self.checkpoint_dir)

@@ -537,8 +537,7 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
 # compact-I/O chunk: the serving-path wrapper around the scan
 
 
-def chunk_compaction(cfg: LaneConfig, T: int, M: int, step,
-                     dense_fills: bool = False):
+def chunk_compaction(cfg: LaneConfig, T: int, M: int, step):
     """Wrap a (state, (T,S) batch) scan `step` with device-side input
     scatter and output compaction.
 
@@ -555,9 +554,10 @@ def chunk_compaction(cfg: LaneConfig, T: int, M: int, step,
     sets the sticky LERR_FILLBUF_FULL error (H3 envelope knob
     `fill_buffer`).
 
-    dense_fills=True instead returns per-message (M, E) fill arrays in
-    the outputs — the small-scale path used under shard_map test meshes,
-    where GSPMD owns data movement and transfer volume is irrelevant.
+    The sharded path wraps the same chunk around the shard_map'd step
+    (parallel/mesh.py): GSPMD gathers each window's compact fills over
+    the mesh and the append lands identically on every shard's
+    replicated log.
 
     Under active-lane compaction (cfg.width > 0) the scan grid is
     (T, W) message slots instead of (T, S) lanes: cb carries a "slot"
@@ -610,28 +610,24 @@ def chunk_compaction(cfg: LaneConfig, T: int, M: int, step,
             "nfill": nfill,
             "nfill_total": total,
         }
-        if dense_fills:
-            couts["fill_oid"], couts["fill_aid"] = fo, fa
-            couts["fill_price"], couts["fill_size"] = fp, fs
-        else:
-            # append to the persistent fill log at the running offset
-            base = state["filloff"][0]
-            offs = base + (jnp.cumsum(nfill) - nfill).astype(_I64)
-            eidx = jnp.arange(E, dtype=_I64)[None, :]
-            mask = eidx < nfill[:, None].astype(_I64)
-            pos = jnp.where(mask, jnp.minimum(offs[:, None] + eidx, FB), FB)
-            pos = pos.astype(_I32).reshape(-1)
-            buf = state["fillbuf"]
-            for c, arr in enumerate((fo, fa, fp, fs)):
-                buf = buf.at[c].set(
-                    buf[c].at[pos].set(arr.astype(_I64).reshape(-1)))
-            new_off = base + total.astype(_I64)
-            err = state["err"]
-            err = jnp.where((err == LERR_OK) & (new_off > FB),
-                            jnp.asarray(LERR_FILLBUF_FULL, _I32), err)
-            state["fillbuf"] = buf
-            state["filloff"] = jnp.full((1,), 0, _I64) + new_off
-            state["err"] = err
+        # append to the persistent fill log at the running offset
+        base = state["filloff"][0]
+        offs = base + (jnp.cumsum(nfill) - nfill).astype(_I64)
+        eidx = jnp.arange(E, dtype=_I64)[None, :]
+        mask = eidx < nfill[:, None].astype(_I64)
+        pos = jnp.where(mask, jnp.minimum(offs[:, None] + eidx, FB), FB)
+        pos = pos.astype(_I32).reshape(-1)
+        buf = state["fillbuf"]
+        for c, arr in enumerate((fo, fa, fp, fs)):
+            buf = buf.at[c].set(
+                buf[c].at[pos].set(arr.astype(_I64).reshape(-1)))
+        new_off = base + total.astype(_I64)
+        err = state["err"]
+        err = jnp.where((err == LERR_OK) & (new_off > FB),
+                        jnp.asarray(LERR_FILLBUF_FULL, _I32), err)
+        state["fillbuf"] = buf
+        state["filloff"] = jnp.full((1,), 0, _I64) + new_off
+        state["err"] = err
         couts["err"] = state["err"]
         return state, couts
 

@@ -54,9 +54,10 @@ def state_specs(state) -> dict:
     specs = {}
     for k, v in state.items():
         if k in ("bal", "bal_used", "err", "fillbuf", "filloff"):
-            # fillbuf/filloff are written only on the single-device path
-            # (the sharded chunk uses dense per-message fills), so they
-            # stay zero and replicate trivially
+            # the packed fill log is REPLICATED: the chunk wrapper runs
+            # under GSPMD, which gathers each window's compact (M, E)
+            # fills over the mesh before the append — so every shard
+            # holds the identical log and the host fetches one slice
             specs[k] = P()
         else:
             specs[k] = P(AXIS)
@@ -91,8 +92,10 @@ def build_sharded_chunk(cfg: L.LaneConfig, mesh: Mesh, T: int, M: int):
     the (M,) message vectors stay replicated, the grid scatter and output
     compaction run under GSPMD (with_sharding_constraint pins the grids
     to the symbol axis), and the scan itself is the shard_map step.
-    Fills return dense per-message (GSPMD moves them; transfer volume is
-    irrelevant at test-mesh scale)."""
+    Fills ride the same packed device log as the single-device path:
+    GSPMD gathers the per-window compact (M, E) fill outputs over the
+    mesh (ICI all-gather of compact data, never dense grids) and the
+    append lands identically on every shard's replicated log."""
     sstep = build_sharded_step(cfg, mesh)
     grid_sh = NamedSharding(mesh, P(None, AXIS))
 
@@ -101,7 +104,7 @@ def build_sharded_chunk(cfg: L.LaneConfig, mesh: Mesh, T: int, M: int):
             lambda x: jax.lax.with_sharding_constraint(x, grid_sh), batch)
         return sstep(state, batch)
 
-    return L.chunk_compaction(cfg, T, M, pinned_step, dense_fills=True)
+    return L.chunk_compaction(cfg, T, M, pinned_step)
 
 
 def build_sharded_settle(cfg: L.LaneConfig, mesh: Mesh):

@@ -106,7 +106,33 @@ def save_session(ckpt_dir: str, session, offset: int) -> str:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    _fsync_dir(ckpt_dir)  # make the rename itself durable
+    _prune(ckpt_dir, _CKPT_RE)
     return path
+
+
+def _fsync_dir(d: str) -> None:
+    fd = os.open(d, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _prune(ckpt_dir: str, pattern, keep: int = 2) -> None:
+    """Unlink all but the newest `keep` snapshots (load only ever uses
+    the newest valid one plus at most one fallback)."""
+    cands = []
+    for name in os.listdir(ckpt_dir):
+        m = pattern.match(name)
+        if m:
+            cands.append((int(m.group(1)), name))
+    cands.sort(reverse=True)
+    for _, name in cands[keep:]:
+        try:
+            os.unlink(os.path.join(ckpt_dir, name))
+        except OSError:
+            pass
 
 
 def _load_file(path: str):
@@ -125,59 +151,64 @@ def load_session(ckpt_dir: str, shards: Optional[int] = None,
     `shards`/`width` override the snapshot's values (elastic restore
     onto a different mesh or compaction width — snapshots are canonical,
     so any combination restores bit-exactly)."""
-    import jax.numpy as jnp
-
-    from kme_tpu.engine.lanes import LaneConfig, make_lane_state
-    from kme_tpu.runtime.session import LaneSession
-
     for offset, path in list_snapshots(ckpt_dir):
         try:
-            data, meta = _load_file(path)
+            return _restore_one(path, shards, width), offset
         except Exception as e:  # torn/corrupt snapshot: fall back
             import sys
 
             print(f"kme_tpu.checkpoint: skipping unreadable snapshot "
                   f"{path}: {e}", file=sys.stderr)
-            continue
-        cfg = LaneConfig(**meta["cfg"])
-        use_shards = meta["shards"] if shards is None else shards
-        use_width = meta["width"] if width is None else width
-        ses = LaneSession(cfg, shards=use_shards, width=use_width or 0)
-        fresh = make_lane_state(ses.dev_cfg)
-        S, A = cfg.lanes, cfg.accounts
-        state = {}
-        for k, v in fresh.items():
-            if k in _SKIP_KEYS:
-                state[k] = v  # recreated empty (drained at snapshot)
-                continue
-            arr = np.asarray(data[k])
-            if k in _LANE_KEYS or k in _POS_KEYS:
-                n = S if k in _LANE_KEYS else S * A
-                if arr.shape[:1] != (n,) or arr.shape[1:] != v.shape[1:]:
-                    raise ValueError(
-                        f"snapshot {path}: shape mismatch for {k}: "
-                        f"{arr.shape} vs canonical ({n},)+{v.shape[1:]}")
-                full = np.array(v)  # writable zeros incl. scrap row
-                full[:n] = arr
-                state[k] = jnp.asarray(full)
-            else:
-                if arr.shape != tuple(v.shape):
-                    raise ValueError(
-                        f"snapshot {path}: shape mismatch for {k}: "
-                        f"{arr.shape} vs {tuple(v.shape)}")
-                state[k] = jnp.asarray(arr)
-        if use_shards > 1:
-            from kme_tpu.parallel import mesh as M
-
-            state = M.shard_state(state, ses.mesh)
-        ses.state = state
-        sch = ses.scheduler
-        sch.aid_idx = {int(k): int(i) for k, i in meta["aid_idx"]}
-        sch.sid_lane = {int(k): int(l) for k, l in meta["sid_lane"]}
-        sch.oid_sid = {int(k): int(s) for k, s in meta["oid_sid"]}
-        sch._rr_lane = int(meta["rr_lane"])
-        return ses, offset
     return None, 0
+
+
+def _restore_one(path: str, shards: Optional[int], width: Optional[int]):
+    """Restore one snapshot file into a live LaneSession (raises on any
+    corruption — load_session falls back to the previous snapshot)."""
+    import jax.numpy as jnp
+
+    from kme_tpu.engine.lanes import LaneConfig, make_lane_state
+    from kme_tpu.runtime.session import LaneSession
+
+    data, meta = _load_file(path)
+    cfg = LaneConfig(**meta["cfg"])
+    use_shards = meta["shards"] if shards is None else shards
+    use_width = meta["width"] if width is None else width
+    ses = LaneSession(cfg, shards=use_shards, width=use_width or 0)
+    fresh = make_lane_state(ses.dev_cfg)
+    S, A = cfg.lanes, cfg.accounts
+    state = {}
+    for k, v in fresh.items():
+        if k in _SKIP_KEYS:
+            state[k] = v  # recreated empty (drained at snapshot)
+            continue
+        arr = np.asarray(data[k])
+        if k in _LANE_KEYS or k in _POS_KEYS:
+            n = S if k in _LANE_KEYS else S * A
+            if arr.shape[:1] != (n,) or arr.shape[1:] != v.shape[1:]:
+                raise ValueError(
+                    f"snapshot {path}: shape mismatch for {k}: "
+                    f"{arr.shape} vs canonical ({n},)+{v.shape[1:]}")
+            full = np.array(v)  # writable zeros incl. scrap row
+            full[:n] = arr
+            state[k] = jnp.asarray(full)
+        else:
+            if arr.shape != tuple(v.shape):
+                raise ValueError(
+                    f"snapshot {path}: shape mismatch for {k}: "
+                    f"{arr.shape} vs {tuple(v.shape)}")
+            state[k] = jnp.asarray(arr)
+    if use_shards > 1:
+        from kme_tpu.parallel import mesh as M
+
+        state = M.shard_state(state, ses.mesh)
+    ses.state = state
+    sch = ses.scheduler
+    sch.aid_idx = {int(k): int(i) for k, i in meta["aid_idx"]}
+    sch.sid_lane = {int(k): int(l) for k, l in meta["sid_lane"]}
+    sch.oid_sid = {int(k): int(s) for k, s in meta["oid_sid"]}
+    sch._rr_lane = int(meta["rr_lane"])
+    return ses
 
 
 # ---------------------------------------------------------------------------
@@ -195,6 +226,8 @@ def save_oracle(ckpt_dir: str, oracle, offset: int) -> str:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    _fsync_dir(ckpt_dir)
+    _prune(ckpt_dir, re.compile(r"^ckpt-(\d+)\.pkl$"))
     return path
 
 

@@ -207,14 +207,12 @@ class LaneSession:
             run.offs = base + np.cumsum(host["nfill"]) - host["nfill"]
             base += int(host["nfill_total"])
             run.outs = None
-        if self.shards == 1:
-            if base:
-                fills = np.asarray(self.state["fillbuf"][:, :base])
-            else:
-                fills = np.zeros((4, 0), np.int64)
-            self.state = L.build_fill_reset(self.dev_cfg)(self.state)
-            return fills
-        return np.zeros((4, 0), np.int64)
+        if base:
+            fills = np.asarray(self.state["fillbuf"][:, :base])
+        else:
+            fills = np.zeros((4, 0), np.int64)
+        self.state = L.build_fill_reset(self.dev_cfg)(self.state)
+        return fills
 
     # ------------------------------------------------------------------
 
@@ -252,8 +250,6 @@ class LaneSession:
         append_of = [False] * nmsg
         act_of = [0] * nmsg
         lane_of = [0] * nmsg
-        dense = self.shards > 1
-        dense_fills_of = {}
         for run in runs:
             n = len(run.idx)
             h = run.host
@@ -271,17 +267,7 @@ class LaneSession:
                 off_of[mi] = offs[k]
                 act_of[mi] = acts[k]
                 lane_of[mi] = lanes_l[k]
-            if dense:
-                for arr, key in ((h["fill_oid"], 0), (h["fill_aid"], 1),
-                                 (h["fill_price"], 2), (h["fill_size"], 3)):
-                    vals = arr[:n].tolist()
-                    for k, mi in enumerate(mis):
-                        dense_fills_of.setdefault(mi, [None] * 4)[key] = vals[k]
-        if dense:
-            f_oid = f_aid = f_price = f_size = None
-        else:
-            f_oid, f_aid, f_price, f_size = (fills[c].tolist()
-                                             for c in range(4))
+        f_oid, f_aid, f_price, f_size = (fills[c].tolist() for c in range(4))
         rejects = {r.msg_index for r in sched.host_rejects}
         barriers = {b.msg_index for b in sched.barriers}
 
@@ -307,18 +293,11 @@ class LaneSession:
                     mk_act = op.SOLD if is_buy else op.BOUGHT
                     tk_act = op.BOUGHT if is_buy else op.SOLD
                     o0 = off_of[i]
-                    if dense:
-                        df = dense_fills_of[i]
                     for e in range(nfill_of[i]):
-                        if dense:
-                            moid, mprice = df[0][e], df[2][e]
-                            maid = idx_to_aid[df[1][e]]
-                            fsz = df[3][e]
-                        else:
-                            moid = f_oid[o0 + e]
-                            maid = idx_to_aid[f_aid[o0 + e]]
-                            mprice = f_price[o0 + e]
-                            fsz = f_size[o0 + e]
+                        moid = f_oid[o0 + e]
+                        maid = idx_to_aid[f_aid[o0 + e]]
+                        mprice = f_price[o0 + e]
+                        fsz = f_size[o0 + e]
                         lines.append(
                             f'OUT {{"action":{mk_act},"oid":{moid},'
                             f'"aid":{maid},"sid":{sid},"price":0,'
@@ -356,7 +335,6 @@ class LaneSession:
             m_of_msg[mi] = np.arange(len(run.idx))
         rejects = {r.msg_index for r in sched.host_rejects}
         barriers_by_msg = {b.msg_index: b for b in sched.barriers}
-        dense = self.shards > 1
 
         out: List[List[OutRecord]] = []
         for i, m in enumerate(msgs):
@@ -383,16 +361,10 @@ class LaneSession:
                     is_buy = lane_act == L.L_BUY
                     o0 = int(run.offs[mm])
                     for e in range(int(h["nfill"][mm])):
-                        if dense:
-                            moid = int(h["fill_oid"][mm, e])
-                            maid = idx_to_aid[int(h["fill_aid"][mm, e])]
-                            mprice = int(h["fill_price"][mm, e])
-                            fsz = int(h["fill_size"][mm, e])
-                        else:
-                            moid = int(fills[0, o0 + e])
-                            maid = idx_to_aid[int(fills[1, o0 + e])]
-                            mprice = int(fills[2, o0 + e])
-                            fsz = int(fills[3, o0 + e])
+                        moid = int(fills[0, o0 + e])
+                        maid = idx_to_aid[int(fills[1, o0 + e])]
+                        mprice = int(fills[2, o0 + e])
+                        fsz = int(fills[3, o0 + e])
                         recs.append(OutRecord("OUT", OrderMsg(
                             action=op.SOLD if is_buy else op.BOUGHT,
                             oid=moid, aid=maid, sid=sid, price=0, size=fsz)))

@@ -173,10 +173,14 @@ def harness_stream(num_events: int = 100_000, seed: int = 0,
 
 def zipf_symbol_stream(num_events: int, num_symbols: int, num_accounts: int,
                        seed: int = 0, zipf_a: float = 1.2,
-                       deposit: int = 10_000_000) -> List[OrderMsg]:
+                       deposit: int = 10_000_000,
+                       payout_per_mille: int = 0) -> List[OrderMsg]:
     """Scale workload for the BASELINE.md throughput configs: Zipf-skewed
-    symbol arrival over many symbols/accounts, valid-domain prices/sizes."""
-    gen = WorkloadGen(num_accounts, num_symbols, seed=seed, validate=True)
+    symbol arrival over many symbols/accounts, valid-domain prices/sizes.
+    payout_per_mille > 0 mixes in real PAYOUT barriers (each immediately
+    followed by a re-ADD of the settled symbol so its lane stays live)."""
+    gen = WorkloadGen(num_accounts, num_symbols, seed=seed, validate=True,
+                      payout_opcode_bug=False)
     msgs: List[OrderMsg] = []
     for aid in range(num_accounts):
         msgs.append(gen.create_account(aid))
@@ -197,7 +201,10 @@ def zipf_symbol_stream(num_events: int, num_symbols: int, num_accounts: int,
         sid = bisect.bisect_left(cdf, u)
         aid = gen._uniform(num_accounts)
         e = gen._uniform(1000)
-        if e < 450:
+        if e < payout_per_mille:
+            msgs.append(gen.create_payout(sid, gen.rng.random() < 0.5))
+            msgs.append(gen.create_symbol(sid))
+        elif e < 450:
             msgs.append(gen.create_buy(aid, sid, gen._normal_param(50, 10),
                                        gen._normal_param(50, 10)))
         elif e < 900:

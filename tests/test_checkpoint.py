@@ -136,3 +136,62 @@ def test_service_crash_resume_at_least_once(tmp_path):
     want = [ln for lines in per_msg[:250] for ln in lines]
     want += [ln for lines in per_msg[200:] for ln in lines]
     assert got == want
+
+
+def test_broker_log_persistence_and_torn_tail(tmp_path):
+    """The broker's append-only topic logs survive a restart; a torn
+    trailing line (crash mid-append) is dropped on reload."""
+    d = str(tmp_path)
+    b1 = InProcessBroker(persist_dir=d)
+    provision(b1)
+    b1.produce(TOPIC_IN, None, '{"action":100,"aid":1}')
+    b1.produce(TOPIC_IN, "k", '{"action":101,"aid":1,"size":5}')
+
+    b2 = InProcessBroker(persist_dir=d)  # restart
+    recs = b2.fetch(TOPIC_IN, 0)
+    assert [(r.offset, r.key, r.value) for r in recs] == [
+        (0, None, '{"action":100,"aid":1}'),
+        (1, "k", '{"action":101,"aid":1,"size":5}')]
+    assert b2.produce(TOPIC_IN, None, "x") == 2  # offsets continue
+
+    with open(tmp_path / f"{TOPIC_IN}.log", "a", encoding="utf-8") as f:
+        f.write('["k", "torn')  # no newline: crash mid-append
+    b3 = InProcessBroker(persist_dir=d)
+    assert b3.end_offset(TOPIC_IN) == 3  # torn tail dropped
+
+
+def test_service_crash_resume_full_process_restart(tmp_path):
+    """The kme-serve topology: broker log AND engine snapshot both live
+    on disk; a full restart (fresh broker + fresh service) resumes and
+    the stream completes bit-identically (at-least-once tail replay)."""
+    msgs = harness_stream(300, seed=31, num_symbols=4, num_accounts=8,
+                          payout_opcode_bug=False, validate=True)
+    per_msg = []
+    ora = OracleEngine("fixed", book_slots=64, max_fills=32)
+    for m in msgs:
+        per_msg.append([r.wire() for r in ora.process(m.copy())])
+
+    log_dir = str(tmp_path / "broker-log")
+    ck_dir = str(tmp_path / "ckpt")
+    kw = dict(engine="lanes", compat="fixed", batch=50, symbols=8,
+              accounts=16, slots=64, max_fills=32,
+              checkpoint_dir=ck_dir, checkpoint_every=100)
+
+    b1 = InProcessBroker(persist_dir=log_dir)
+    provision(b1)
+    for m in msgs:
+        b1.produce(TOPIC_IN, None, dumps_order(m))
+    svc1 = MatchService(b1, **kw)
+    assert svc1.run(max_messages=150) == 150  # snapshot at 100
+    del svc1, b1  # the whole process dies
+
+    b2 = InProcessBroker(persist_dir=log_dir)  # broker log reloaded
+    svc2 = MatchService(b2, **kw)
+    assert svc2.offset == 100
+    rest = len(msgs) - 100
+    assert svc2.run(max_messages=rest) == rest
+
+    got = list(consume_lines(b2, follow=False))
+    want = [ln for lines in per_msg[:150] for ln in lines]
+    want += [ln for lines in per_msg[100:] for ln in lines]
+    assert got == want
