@@ -98,6 +98,7 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
 
     n = len(msgs)
     total = t_plan + t_disp + t_fetch + t_recon
+    metrics = ses.metrics()
     nfills = sum(int(r.host["nfill_total"]) for r in runs)
     # slice to the real placements: the M bucket is padded and padding
     # entries report ok=False
@@ -128,8 +129,33 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
             "parity_checked_msgs": prefix,
             "backend": jax.devices()[0].platform,
             "baseline_assumption_ops": REFERENCE_BASELINE_OPS,
+            # on-device counters (scan-carry accumulated) + gauges
+            "device_metrics": metrics,
+            # utilization: device-busy fraction of the e2e wall, and an
+            # HBM-traffic estimate for the scan (dominant modeled terms:
+            # the two position-array scatter copies r+w per step, plus
+            # the gathered/scattered book rows) — integer workload, so
+            # bandwidth-bound utilization is the honest analog of MFU
+            "device_busy_frac": round((t_disp + t_fetch) / total, 3),
+            "per_step_us": round(t_disp / max(steps_total, 1) * 1e6, 1),
+            "est_hbm_gbps": round(
+                _est_step_bytes(
+                    symbols + (1 if shards == 1 and width > 0 else 0),
+                    accounts, slots, max_fills,
+                    width if shards == 1 and width > 0 else symbols)
+                * steps_total / max(t_disp, 1e-9) / 1e9, 1),
         },
     }
+
+
+def _est_step_bytes(S, A, N, E, W) -> int:
+    """Modeled HBM bytes touched per scan step (see bench detail note):
+    pos_amt/pos_avail scatter copies (read+write, 8B each), 6 slot-row
+    arrays gathered + scattered at width W, fill outputs."""
+    pos = 2 * 2 * 8 * S * A
+    rows = 2 * 6 * W * 2 * N * 4
+    fills = 4 * W * E * 8
+    return pos + rows + fills
 
 
 def bench_parity_engine(events: int = 4096, seed: int = 0, batch: int = 256,

@@ -78,6 +78,11 @@ def main(argv=None) -> int:
         if args.checkpoint_dir is not None:
             svc.checkpoint()
         print(f"kme-serve: processed {seen} records", file=sys.stderr)
+        met = svc.metrics()
+        if met is not None:
+            import json
+
+            print(f"kme-serve: metrics {json.dumps(met)}", file=sys.stderr)
     except KeyboardInterrupt:
         pass
     finally:

@@ -191,6 +191,10 @@ class MatchService:
         self._maybe_checkpoint()
         return len(recs)
 
+    def metrics(self) -> Optional[dict]:
+        """On-device counters+gauges (lanes engine; None for oracle)."""
+        return self._session.metrics() if self._session is not None else None
+
     def run(self, max_messages: Optional[int] = None,
             idle_exit: Optional[float] = None,
             poll_timeout: float = 0.5) -> int:

@@ -75,6 +75,27 @@ L_ADD_SYMBOL = 6
 LERR_OK = 0
 LERR_FILLBUF_FULL = 3  # session fill log exhausted (fill_buffer knob)
 
+# on-device metrics counters (state["metrics"], int64, accumulated in
+# the scan carry and psum-merged under sharding — SURVEY.md §5's
+# replacement for the reference's untouched JMX metrics)
+MET_MSGS = 0            # device-executed messages (non-NOP)
+MET_TRADES_OK = 1       # accepted BUY/SELL
+MET_FILLS = 2           # fill events (maker count)
+MET_CONTRACTS = 3       # contracts traded (sum of fill sizes)
+MET_REJ_CAPACITY = 4    # H2/H3 envelope rejects
+MET_REJ_RISK = 5        # margin/validation rejects
+MET_RESTED = 6          # orders appended to a book
+MET_CANCELS_OK = 7
+MET_REJ_CANCEL = 8
+MET_TRANSFERS_OK = 9
+MET_REJ_OTHER = 10      # failed create/transfer/add_symbol
+MET_BARRIERS = 11       # payout/remove settles executed
+N_METRICS = 12
+
+METRIC_NAMES = ("msgs", "trades_ok", "fills", "contracts", "rej_capacity",
+                "rej_risk", "rested", "cancels_ok", "rej_cancel",
+                "transfers_ok", "rej_other", "barriers")
+
 
 @dataclasses.dataclass(frozen=True)
 class LaneConfig:
@@ -127,6 +148,7 @@ def make_lane_state(cfg: LaneConfig):
         "bal": jnp.zeros((A,), _I64),
         "bal_used": jnp.zeros((A,), bool),
         "err": jnp.zeros((), _I32),
+        "metrics": jnp.zeros((N_METRICS,), _I64),
         # persistent fill log: rows oid/aid/price/size, one slot of slack
         # for clamped overflow writes; filloff = next free position. Only
         # the used prefix ever crosses to the host (ONE sliced fetch per
@@ -480,6 +502,28 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
             # replicated err stays identical across shards)
             err = jax.lax.pmax(err, axis_name)
 
+        # ------------------------------------------------ metrics delta
+        cnt = lambda m: jnp.sum(m.astype(_I64))
+        met = jnp.stack([
+            cnt(act != L_NOP),                                 # MSGS
+            cnt(trade_acc),                                    # TRADES_OK
+            jnp.sum(jnp.where(trade_acc, nfill, 0).astype(_I64)),
+            jnp.sum(jnp.where(trade_acc, filled_total, 0).astype(_I64)),
+            cnt(cap_reject),                                   # REJ_CAPACITY
+            cnt(is_trade & ~trade_ok),                         # REJ_RISK
+            cnt(do_rest),                                      # RESTED
+            cnt(cancel_ok),                                    # CANCELS_OK
+            cnt(is_cancel & ~cancel_ok),                       # REJ_CANCEL
+            cnt(transfer_ok),                                  # TRANSFERS_OK
+            cnt(((act == L_CREATE) & ~create_ok)
+                | ((act == L_TRANSFER) & ~transfer_ok)
+                | ((act == L_ADD_SYMBOL) & ~addsym_ok)),       # REJ_OTHER
+            jnp.zeros((), _I64),                               # BARRIERS
+        ])
+        if axis_name is not None:
+            met = jax.lax.psum(met, axis_name)
+        metrics = st["metrics"] + met
+
         ok = jnp.where(
             is_trade, trade_acc,
             jnp.where(is_cancel, cancel_ok,
@@ -505,13 +549,15 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
             new_st["book_exists"] = st["book_exists"].at[lanes].set(book_exists)
             new_st["pos_amt"] = pa_f
             new_st["pos_avail"] = pv_f
-            new_st.update(bal=bal, bal_used=bal_used, err=err)
+            new_st.update(bal=bal, bal_used=bal_used, err=err,
+                          metrics=metrics)
         else:
             new_st = {
                 **new_rows,
                 "seq": seq, "book_exists": book_exists,
                 "pos_amt": pa_f, "pos_avail": pv_f,
                 "bal": bal, "bal_used": bal_used, "err": err,
+                "metrics": metrics,
                 "fillbuf": st["fillbuf"], "filloff": st["filloff"],
             }
         outs = {
@@ -643,6 +689,25 @@ def build_lane_chunk(cfg: LaneConfig, T: int, M: int):
 
 
 @functools.lru_cache(maxsize=None)
+def build_gauges(cfg: LaneConfig):
+    """Jitted point-in-time gauges over the lane state (book depth,
+    open orders, live books/accounts/positions) — the state-derived half
+    of the observability surface; counters live in state['metrics']."""
+    def gauges(state):
+        used = state["slot_used"]
+        depth = jnp.sum(used.astype(_I32), axis=2)     # (S, 2)
+        return {
+            "open_orders": jnp.sum(used.astype(_I64)),
+            "books": jnp.sum(state["book_exists"].astype(_I64)),
+            "accounts": jnp.sum(state["bal_used"].astype(_I64)),
+            "positions": jnp.sum((state["pos_amt"] != 0).astype(_I64)),
+            "max_book_depth": jnp.max(depth).astype(_I64),
+        }
+
+    return jax.jit(gauges)
+
+
+@functools.lru_cache(maxsize=None)
 def build_fill_reset(cfg: LaneConfig):
     """Tiny jitted op: rewind the fill log (the host consumed it)."""
     def reset(state):
@@ -771,6 +836,8 @@ def build_barrier_ops(cfg: LaneConfig, axis_name: Optional[str] = None):
         else:
             do_any = do
         st["bal"] = st["bal"] + bal_delta
+        st["metrics"] = st["metrics"].at[MET_BARRIERS].add(
+            do_any.astype(_I64))
         return st, do_any
 
     return settle

@@ -182,6 +182,9 @@ def _restore_one(path: str, shards: Optional[int], width: Optional[int]):
         if k in _SKIP_KEYS:
             state[k] = v  # recreated empty (drained at snapshot)
             continue
+        if k == "metrics" and k not in data.files:
+            state[k] = v  # pure observability counter: pre-metrics
+            continue      # snapshots restore with fresh zeros
         arr = np.asarray(data[k])
         if k in _LANE_KEYS or k in _POS_KEYS:
             n = S if k in _LANE_KEYS else S * A

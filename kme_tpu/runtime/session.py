@@ -385,6 +385,16 @@ class LaneSession:
 
     # ------------------------------------------------------------------
 
+    def metrics(self) -> Dict[str, int]:
+        """On-device observability: cumulative counters (accumulated in
+        the scan carry, psum-merged under sharding) + point-in-time
+        gauges. One tiny device reduce per call — never per message."""
+        counters = dict(zip(L.METRIC_NAMES,
+                            np.asarray(self.state["metrics"]).tolist()))
+        gauges = L.build_gauges(self.dev_cfg)(self.state)
+        counters.update({k: int(np.asarray(v)) for k, v in gauges.items()})
+        return counters
+
     def export_state(self) -> Dict[str, dict]:
         """Host dict view comparable to the oracle's stores (fixed mode)."""
         s = jax.tree.map(np.asarray, self.state)
