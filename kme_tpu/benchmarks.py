@@ -158,7 +158,7 @@ def _est_step_bytes(S, A, N, E, W) -> int:
     return pos + rows + fills
 
 
-def bench_parity_engine(events: int = 4096, seed: int = 0, batch: int = 256,
+def bench_parity_engine(events: int = 4096, seed: int = 0, batch: int = 2048,
                         compat: str = "java") -> dict:
     """Throughput of the serial device parity engine on the stock harness
     workload (the quirk-exact replica — correctness path, not the
@@ -213,7 +213,7 @@ def main(argv=None) -> int:
                    help="post-preamble messages checked against the oracle")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="dump a jax.profiler trace of the timed run to DIR")
-    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--batch", type=int, default=2048)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--compat", choices=("java", "fixed"), default="java")
     args = p.parse_args(argv)

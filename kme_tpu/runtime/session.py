@@ -43,12 +43,7 @@ class LaneEngineError(RuntimeError):
             f"lane engine error: {_LERR_NAMES.get(self.code, self.code)}")
 
 
-def _bucket(n: int, lo: int = 64) -> int:
-    """Round up to a power-of-two bucket to bound XLA recompiles."""
-    b = lo
-    while b < n:
-        b *= 2
-    return b
+from kme_tpu.utils import pow2_bucket as _bucket
 
 
 @dataclasses.dataclass
@@ -191,12 +186,10 @@ class LaneSession:
         then materialize; check the sticky error; slice the used prefix
         of the persistent fill log and rewind it. Returns the packed
         (4, F_used) fill log [oid, aid, price, size]."""
+        from kme_tpu.utils import async_prefetch
+
         for run in runs:
-            for v in run.outs.values():
-                try:
-                    v.copy_to_host_async()
-                except AttributeError:  # older jax / non-array leaf
-                    pass
+            async_prefetch(run.outs.values())
         base = 0
         for run in runs:
             host = {k: np.asarray(v) for k, v in run.outs.items()}
