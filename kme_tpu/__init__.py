@@ -18,21 +18,27 @@ integer `psum` collectives over ICI.
 Package layout:
   oracle/    quirk-faithful pure-Python replica of the reference semantics
              (the golden parity judge; compat='java' and compat='fixed')
-  models/    the assembled engine models (batched device engine + host session)
-  ops/       device kernels: lane step (risk/match/insert/cancel), Pallas
-             matcher, exact bit/codec utilities
-  parallel/  mesh construction, sharding specs, collectives
-  runtime/   native C++ host runtime (wire parse, oid index, scheduler,
-             event decode) with a pure-Python fallback
-  bridge/    transport edge speaking the reference's Kafka wire contract
-  utils/     events, snapshots, metrics, profiling
+  engine/    the device engines: parity.py (serial quirk-exact replica as
+             one lax.scan) and lanes.py (the throughput engine: compacted
+             per-symbol lanes, sort+prefix-sum matching, on-device
+             metrics, packed fill log)
+  ops/       exact bit/codec device utilities and associative tables
+  parallel/  mesh construction, sharding specs, psum-merged collectives
+  runtime/   host runtime: conflict-free scheduler (sequencer.py), the
+             batching session with compact device I/O (session.py), and
+             checkpoint/resume (checkpoint.py)
+  bridge/    transport edge speaking the reference's Kafka wire contract:
+             broker core with durable logs, TCP process boundary, and the
+             MatchIn -> engine -> MatchOut service + CLIs
+  wire/workload/opcodes/benchmarks/cli  byte-exact serde, seeded harness
+             workloads, protocol constants, bench suite, entry points
 
+Compatibility envelope and mode matrix: COMPAT.md at the repo root.
 The top-level package is import-light: the pure-Python layers (wire,
-oracle, workload, config) work without JAX. Device modules (models/, ops/,
+oracle, workload) work without JAX. Device modules (engine/, ops/,
 parallel/) import `kme_tpu._jaxsetup` which enables x64 once.
 """
 
 __version__ = "0.1.0"
 
-from kme_tpu.config import EngineConfig  # noqa: F401
 from kme_tpu import opcodes  # noqa: F401
