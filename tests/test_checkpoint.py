@@ -76,6 +76,37 @@ def test_session_resume_across_width_configs(tmp_path):
     assert head + tail == want
 
 
+def test_session_elastic_reshard_on_restore(tmp_path):
+    """The rebalance analog (SURVEY.md §2.3): a single-device session's
+    snapshot restores onto a 4-shard mesh (and back) mid-stream, and the
+    continuation is bit-identical — symbol->shard reassignment is a
+    checkpoint/restore cycle, replacing Kafka Streams' group rebalance +
+    changelog restore."""
+    cfg = LaneConfig(lanes=8, slots=64, accounts=32, max_fills=32, steps=16)
+    msgs = _stream(600, seed=12)
+    cut1, cut2 = 200, 400
+
+    full = LaneSession(cfg)
+    want = full.process_wire([m.copy() for m in msgs])
+    want_state = full.export_state()
+
+    a = LaneSession(cfg)  # 1 device, compact
+    got = a.process_wire([m.copy() for m in msgs[:cut1]])
+    ck.save_session(str(tmp_path), a, offset=cut1)
+
+    b, off = ck.load_session(str(tmp_path), shards=4)  # scale OUT to 4
+    assert off == cut1 and b.shards == 4
+    got += b.process_wire([m.copy() for m in msgs[cut1:cut2]])
+    ck.save_session(str(tmp_path), b, offset=cut2)
+
+    c, off = ck.load_session(str(tmp_path), shards=1)  # scale back IN
+    assert off == cut2 and c.shards == 1
+    got += c.process_wire([m.copy() for m in msgs[cut2:]])
+
+    assert got == want
+    assert c.export_state() == want_state
+
+
 def test_corrupt_latest_snapshot_falls_back(tmp_path):
     msgs = _stream(300, seed=9)
     ses = LaneSession(CFG)
