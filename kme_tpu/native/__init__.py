@@ -1,0 +1,130 @@
+"""Native host-runtime bindings: build-on-demand C++ via ctypes.
+
+The C++ sources compile once per source hash with the system toolchain
+(g++) into a cached shared object next to the package; everything
+degrades gracefully to the pure-Python implementations when no compiler
+is available (`load_library()` returns None). `KME_NATIVE=0` disables
+the native path outright.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "kme_host.cpp")
+
+_lib = None
+_lib_tried = False
+
+
+def _build(src: str, out: str) -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", out]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        print(f"kme_tpu.native: build failed ({e}); using the pure-Python "
+              f"fallback", file=sys.stderr)
+        return False
+    if r.returncode != 0:
+        print(f"kme_tpu.native: g++ failed:\n{r.stderr[:2000]}\n"
+              f"using the pure-Python fallback", file=sys.stderr)
+        return False
+    return True
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The compiled host-runtime library, building it if needed.
+    None when disabled or unbuildable (callers fall back to Python)."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("KME_NATIVE", "1") == "0":
+        return None
+    try:
+        with open(_SRC, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    build_dir = os.path.join(_HERE, "_build")
+    so_path = os.path.join(build_dir, f"kme_host_{tag}.so")
+    if not os.path.exists(so_path):
+        try:
+            os.makedirs(build_dir, exist_ok=True)
+            # build into a temp name then rename: concurrent processes
+            # race benignly (os.replace is atomic)
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=build_dir)
+            os.close(fd)
+            built = _build(_SRC, tmp)
+            if built:
+                os.replace(tmp, so_path)
+            else:
+                os.unlink(tmp)
+                return None
+        except OSError as e:  # read-only install dir etc.
+            print(f"kme_tpu.native: cannot build ({e}); using the "
+                  f"pure-Python fallback", file=sys.stderr)
+            return None
+    try:
+        _lib = _bind(ctypes.CDLL(so_path))
+    except OSError as e:
+        print(f"kme_tpu.native: dlopen failed ({e}); using the pure-Python "
+              f"fallback", file=sys.stderr)
+        _lib = None
+    return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    P64, P32 = c.POINTER(c.c_int64), c.POINTER(c.c_int32)
+    sigs = {
+        "kme_sched_new": ([c.c_int32, c.c_int32, c.c_int32], c.c_void_p),
+        "kme_sched_free": ([c.c_void_p], None),
+        "kme_sched_plan": ([c.c_void_p, c.c_int64] + [P64] * 6, c.c_int32),
+        "kme_sched_n_placed": ([c.c_void_p], c.c_int64),
+        "kme_sched_p_msg": ([c.c_void_p], P64),
+        "kme_sched_p_seg": ([c.c_void_p], P32),
+        "kme_sched_p_step": ([c.c_void_p], P32),
+        "kme_sched_p_lane": ([c.c_void_p], P32),
+        "kme_sched_p_act": ([c.c_void_p], P32),
+        "kme_sched_p_aidx": ([c.c_void_p], P32),
+        "kme_sched_p_oid": ([c.c_void_p], P64),
+        "kme_sched_p_price": ([c.c_void_p], P32),
+        "kme_sched_p_size": ([c.c_void_p], P32),
+        "kme_sched_p_slot": ([c.c_void_p], P32),
+        "kme_sched_n_barriers": ([c.c_void_p], c.c_int64),
+        "kme_sched_b_msg": ([c.c_void_p], P64),
+        "kme_sched_b_lane": ([c.c_void_p], P32),
+        "kme_sched_b_mode": ([c.c_void_p], P32),
+        "kme_sched_b_credit": ([c.c_void_p], P64),
+        "kme_sched_n_rejects": ([c.c_void_p], c.c_int64),
+        "kme_sched_r_msg": ([c.c_void_p], P64),
+        "kme_sched_n_segments": ([c.c_void_p], c.c_int64),
+        "kme_sched_seg_steps": ([c.c_void_p], P32),
+        "kme_sched_n_program": ([c.c_void_p], c.c_int64),
+        "kme_sched_program": ([c.c_void_p], P32),
+        "kme_sched_err_value": ([c.c_void_p], c.c_int64),
+        "kme_sched_n_accounts": ([c.c_void_p], c.c_int64),
+        "kme_sched_n_symbols": ([c.c_void_p], c.c_int64),
+        "kme_sched_n_routes": ([c.c_void_p], c.c_int64),
+        "kme_sched_rr_lane": ([c.c_void_p], c.c_int32),
+        "kme_sched_set_rr_lane": ([c.c_void_p, c.c_int32], None),
+        "kme_sched_export_accounts": ([c.c_void_p, P64, P32], None),
+        "kme_sched_export_symbols": ([c.c_void_p, P64, P32], None),
+        "kme_sched_export_routes": ([c.c_void_p, P64, P64], None),
+        "kme_sched_import_accounts": ([c.c_void_p, c.c_int64, P64, P32], None),
+        "kme_sched_import_symbols": ([c.c_void_p, c.c_int64, P64, P32], None),
+        "kme_sched_import_routes": ([c.c_void_p, c.c_int64, P64, P64], None),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    return lib

@@ -115,6 +115,23 @@ class Schedule:
 _TRADE_ACTS = {op.BUY: L.L_BUY, op.SELL: L.L_SELL}
 
 
+def make_scheduler(num_lanes: int, num_accounts: int, width: int = 0):
+    """The native C++ scheduler when the toolchain/library is available
+    (KME_NATIVE=0 disables), else this module's Python implementation —
+    identical plans either way (tests/test_native_sched.py)."""
+    try:
+        from kme_tpu.native.sched import NativeScheduler, native_available
+
+        if native_available():
+            return NativeScheduler(num_lanes, num_accounts, width)
+    except Exception as e:  # pragma: no cover - defensive fallback
+        import sys
+
+        print(f"kme_tpu: native scheduler unavailable ({e}); "
+              f"using the Python fallback", file=sys.stderr)
+    return Scheduler(num_lanes, num_accounts, width)
+
+
 class Scheduler:
     def __init__(self, num_lanes: int, num_accounts: int,
                  width: int = 0) -> None:
@@ -242,40 +259,46 @@ class Scheduler:
                 raise EnvelopeError(
                     f"message {i}: price/size outside int32 "
                     f"(price={m.price}, size={m.size})")
+            # the id spaces are Java longs (the Jackson envelope,
+            # KProcessor.java:451-455): wrap ONCE here so the Python and
+            # native schedulers key their maps identically
+            aid, sid, oid = jl.jlong(m.aid), jl.jlong(m.sid), jl.jlong(m.oid)
             if a in _TRADE_ACTS:
-                lane = self._lane(m.sid)
-                aidx = self._acct(m.aid)
-                self.oid_sid[m.oid] = m.sid
-                place(i, lane, _TRADE_ACTS[a], aidx, m, actor_key=m.aid)
+                lane = self._lane(sid)
+                aidx = self._acct(aid)
+                self.oid_sid[oid] = sid
+                place(i, lane, _TRADE_ACTS[a], aidx, m, actor_key=aid)
             elif a == op.CANCEL:
                 # route stays mapped even after a cancel attempt: a cancel
                 # can fail (wrong owner) and be retried, and a second
                 # cancel of a gone order correctly rejects on device
-                sid = self.oid_sid.get(m.oid)
-                if sid is None:
+                rsid = self.oid_sid.get(oid)
+                if rsid is None:
+                    host_rejects.append(HostReject(i))
+                    continue
+                lane = self._lane(rsid)
+                aidx = self._acct(aid)
+                place(i, lane, L.L_CANCEL, aidx, m, actor_key=aid)
+            elif a == op.CREATE_BALANCE:
+                aidx = self._acct(aid)
+                step_floor = actor_next.get(aid, 0)
+                lane = free_lane(step_floor)
+                place(i, lane, L.L_CREATE, aidx, m, actor_key=aid)
+            elif a == op.TRANSFER:
+                aidx = self._acct(aid)
+                step_floor = actor_next.get(aid, 0)
+                lane = free_lane(step_floor)
+                place(i, lane, L.L_TRANSFER, aidx, m, actor_key=aid)
+            elif a == op.ADD_SYMBOL:
+                if sid < 0:
                     host_rejects.append(HostReject(i))
                     continue
                 lane = self._lane(sid)
-                aidx = self._acct(m.aid)
-                place(i, lane, L.L_CANCEL, aidx, m, actor_key=m.aid)
-            elif a == op.CREATE_BALANCE:
-                aidx = self._acct(m.aid)
-                step_floor = actor_next.get(m.aid, 0)
-                lane = free_lane(step_floor)
-                place(i, lane, L.L_CREATE, aidx, m, actor_key=m.aid)
-            elif a == op.TRANSFER:
-                aidx = self._acct(m.aid)
-                step_floor = actor_next.get(m.aid, 0)
-                lane = free_lane(step_floor)
-                place(i, lane, L.L_TRANSFER, aidx, m, actor_key=m.aid)
-            elif a == op.ADD_SYMBOL:
-                if m.sid < 0:
-                    host_rejects.append(HostReject(i))
-                    continue
-                lane = self._lane(m.sid)
                 place(i, lane, L.L_ADD_SYMBOL, 0, m, actor_key=None)
             elif a in (op.REMOVE_SYMBOL, op.PAYOUT):
-                s = abs(m.sid)
+                # abs(INT64_MIN) = 2^63 can never be a (wrapped) map key,
+                # so a payout/remove of that sid host-rejects
+                s = abs(sid)
                 if s not in self.sid_lane:
                     host_rejects.append(HostReject(i))
                     continue
@@ -284,7 +307,7 @@ class Scheduler:
                 if a == op.REMOVE_SYMBOL:
                     mode = 0
                 else:
-                    mode = 1 if m.sid >= 0 else 2
+                    mode = 1 if sid >= 0 else 2
                 barriers.append(Barrier(i, lane, mode, m.size))
                 program.append(("barrier", len(barriers) - 1))
                 # a wiped lane may be re-added later; resting-oid routes

@@ -28,7 +28,7 @@ import jax
 
 from kme_tpu import opcodes as op
 from kme_tpu.engine import lanes as L
-from kme_tpu.runtime.sequencer import Schedule, Scheduler
+from kme_tpu.runtime.sequencer import Schedule, make_scheduler
 from kme_tpu.wire import OrderMsg, OutRecord
 
 _LERR_NAMES = {
@@ -101,7 +101,7 @@ class LaneSession:
             self.state = L.make_lane_state(self.dev_cfg)
             self._settle = jax.jit(L.build_barrier_ops(self.dev_cfg),
                                    donate_argnums=(0,))
-        self.scheduler = Scheduler(cfg.lanes, cfg.accounts, width=W)
+        self.scheduler = make_scheduler(cfg.lanes, cfg.accounts, width=W)
 
     # ------------------------------------------------------------------
 

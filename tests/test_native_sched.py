@@ -1,0 +1,115 @@
+"""Native C++ scheduler vs the Python semantics authority.
+
+The native scheduler must produce IDENTICAL plans — every column, every
+barrier, every segment boundary — on representative workloads, raise the
+same capacity/envelope errors, and round-trip its id-space state through
+the checkpoint surface."""
+
+import numpy as np
+import pytest
+
+import kme_tpu.opcodes as op
+from kme_tpu.runtime.sequencer import CapacityError, EnvelopeError, Scheduler
+from kme_tpu.wire import OrderMsg
+from kme_tpu.workload import (cancel_heavy_stream, harness_stream,
+                              zipf_symbol_stream)
+
+native = pytest.importorskip("kme_tpu.native.sched")
+if not native.native_available():
+    pytest.skip("native library unavailable (no toolchain)",
+                allow_module_level=True)
+
+
+def assert_same_plan(msgs, lanes, accounts, width):
+    py = Scheduler(lanes, accounts, width)
+    cc = native.NativeScheduler(lanes, accounts, width)
+    sp = py.plan(msgs)
+    sc = cc.plan(msgs)
+    for k in sp.cols:
+        assert np.array_equal(sp.cols[k], sc.cols[k]), f"col {k} differs"
+    assert sp.barriers == sc.barriers
+    assert sp.host_rejects == sc.host_rejects
+    assert list(sp.segment_steps) == list(sc.segment_steps)
+    assert sp.program == sc.program
+    assert py.aid_idx == cc.aid_idx
+    assert py.sid_lane == cc.sid_lane
+    assert py.oid_sid == cc.oid_sid
+    assert py._rr_lane == cc._rr_lane
+    return py, cc
+
+
+@pytest.mark.parametrize("width", [0, 1, 8])
+def test_plans_identical_harness(width):
+    msgs = harness_stream(1500, seed=3, num_symbols=4, num_accounts=8,
+                          payout_opcode_bug=False, validate=True)
+    assert_same_plan(msgs, 8, 16, width)
+
+
+def test_plans_identical_zipf_with_barriers():
+    msgs = zipf_symbol_stream(2000, num_symbols=16, num_accounts=32, seed=9,
+                              zipf_a=1.1, payout_per_mille=5)
+    assert_same_plan(msgs, 16, 64, 8)
+
+
+def test_plans_identical_cancel_heavy_multi_batch():
+    msgs = cancel_heavy_stream(1500, num_symbols=8, num_accounts=16, seed=4)
+    py = Scheduler(8, 32, 8)
+    cc = native.NativeScheduler(8, 32, 8)
+    for lo in range(0, len(msgs), 400):  # id maps persist across plans
+        sp = py.plan(msgs[lo:lo + 400])
+        sc = cc.plan(msgs[lo:lo + 400])
+        for k in sp.cols:
+            assert np.array_equal(sp.cols[k], sc.cols[k]), f"col {k}@{lo}"
+        assert sp.program == sc.program
+    assert py.oid_sid == cc.oid_sid
+
+
+def test_native_errors_match():
+    cc = native.NativeScheduler(2, 2, 0)
+    with pytest.raises(CapacityError, match="symbol capacity"):
+        cc.plan([OrderMsg(action=op.ADD_SYMBOL, sid=s) for s in range(3)])
+    cc2 = native.NativeScheduler(8, 1, 0)
+    with pytest.raises(CapacityError, match="account capacity"):
+        cc2.plan([OrderMsg(action=op.CREATE_BALANCE, aid=a)
+                  for a in range(2)])
+    cc3 = native.NativeScheduler(8, 8, 0)
+    with pytest.raises(EnvelopeError):
+        cc3.plan([OrderMsg(action=op.BUY, oid=1, aid=1, sid=0,
+                           price=2**31, size=1)])
+
+
+def test_plans_identical_extreme_ids():
+    """Java-long id wrapping at the scheduler boundary: out-of-int64
+    aids/sids/oids and INT64_MIN payout targets plan identically."""
+    big = 2**63
+    msgs = [
+        OrderMsg(action=op.CREATE_BALANCE, aid=big),       # wraps to -2^63
+        OrderMsg(action=op.CREATE_BALANCE, aid=-big),      # same account
+        OrderMsg(action=op.TRANSFER, aid=big, size=1000),
+        OrderMsg(action=op.ADD_SYMBOL, sid=2**63 - 1),
+        OrderMsg(action=op.BUY, oid=2**64 + 7, aid=big, sid=2**63 - 1,
+                 price=50, size=2),
+        OrderMsg(action=op.CANCEL, oid=7, aid=big),        # wrapped route
+        OrderMsg(action=op.PAYOUT, sid=-big, size=97),     # abs(INT64_MIN)
+        OrderMsg(action=2**70, aid=1),                     # unknown opcode
+    ]
+    assert_same_plan(msgs, 4, 4, 2)
+
+
+def test_native_state_roundtrip():
+    """The checkpoint surface: export the id maps, import into a fresh
+    native scheduler, and plans continue identically."""
+    msgs = harness_stream(800, seed=7, num_symbols=4, num_accounts=8,
+                          payout_opcode_bug=False, validate=True)
+    cc = native.NativeScheduler(8, 16, 8)
+    cc.plan(msgs[:500])
+    state = (cc.aid_idx, cc.sid_lane, cc.oid_sid, cc._rr_lane)
+
+    cc2 = native.NativeScheduler(8, 16, 8)
+    cc2.aid_idx, cc2.sid_lane, cc2.oid_sid, cc2._rr_lane = state
+    py = Scheduler(8, 16, 8)
+    py.plan(msgs[:500])
+    sp = py.plan(msgs[500:])
+    sc = cc2.plan(msgs[500:])
+    for k in sp.cols:
+        assert np.array_equal(sp.cols[k], sc.cols[k]), f"col {k} differs"
