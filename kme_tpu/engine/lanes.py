@@ -139,7 +139,12 @@ def make_lane_state(cfg: LaneConfig):
         # A 2-D (S, A) layout costs a physical re-tiling copy per scan
         # step on TPU for the reshape to flat scatter indices (profiled:
         # ~100us/step in reshape copies + un-aliased scatters); flat
-        # arrays scatter in place under the donated carry.
+        # arrays scatter with far less traffic, though XLA:TPU scatter
+        # still rewrites the array (~1us/MB — the dominant per-step HBM
+        # term, see the bench's est_hbm_gbps model). A per-lane (S, P)
+        # associative table was evaluated and rejected: hot-symbol
+        # holder counts approach A on skewed workloads, so P cannot
+        # shrink below O(A) without spuriously capacity-rejecting them.
         # There is no `used` flag: in fixed mode a position exists iff
         # amt != 0 (delete-at-zero, KProcessor.java:281-284 corrected),
         # and the engine maintains avail == 0 whenever amt == 0.
