@@ -29,6 +29,10 @@ REFERENCE_BASELINE_OPS = 5_000.0  # orders/sec, derived bound (BASELINE.md)
 # default stays 16 for that reason).
 DEFAULT_WIDTH = 4
 
+# Latency-suite micro-batch size (one constant for the function, the
+# CLI, and the BASELINE.md row).
+DEFAULT_LATENCY_BATCH = 2048
+
 
 def _assert_parity_prefix(msgs, cfg, shards, prefix: int,
                           width: int) -> None:
@@ -53,19 +57,26 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
                       slots: int = 128, max_fills: int = 16,
                       shards: int = 1, parity_prefix: int = 2000,
                       width: int = DEFAULT_WIDTH,
+                      workload: str = "zipf",
                       profile_dir: str = None) -> dict:
-    """End-to-end lane-engine throughput (see module docstring)."""
+    """End-to-end lane-engine throughput (see module docstring).
+    workload: 'zipf' (the headline row) or 'cancel' (the bursty
+    cancel/replace BASELINE.md row)."""
     import jax
 
     from kme_tpu.engine.lanes import LaneConfig
     from kme_tpu.runtime.session import LaneSession
-    from kme_tpu.workload import zipf_symbol_stream
+    from kme_tpu.workload import cancel_heavy_stream, zipf_symbol_stream
 
     cfg = LaneConfig(lanes=symbols, slots=slots, accounts=accounts,
                      max_fills=max_fills, steps=steps)
-    msgs = zipf_symbol_stream(events, num_symbols=symbols,
-                              num_accounts=accounts, seed=seed,
-                              zipf_a=zipf_a)
+    if workload == "cancel":
+        msgs = cancel_heavy_stream(events, num_symbols=symbols,
+                                   num_accounts=accounts, seed=seed)
+    else:
+        msgs = zipf_symbol_stream(events, num_symbols=symbols,
+                                  num_accounts=accounts, seed=seed,
+                                  zipf_a=zipf_a)
 
     # correctness inside the bench: oracle parity on a stream prefix that
     # extends past the preamble into the trade mix
@@ -130,6 +141,7 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
         "vs_baseline": round(ops / REFERENCE_BASELINE_OPS, 3),
         "detail": {
             "events": n, "symbols": symbols, "accounts": accounts,
+            "workload": workload,
             "zipf_a": zipf_a, "shards": shards, "slots": slots,
             "max_fills": max_fills, "width": width,
             "plan_s": round(t_plan, 3), "dispatch_s": round(t_disp, 3),
@@ -209,7 +221,7 @@ def bench_latency(events: int = 20_000, symbols: int = 1024,
                   accounts: int = 2048, seed: int = 0, zipf_a: float = 1.2,
                   slots: int = 128, max_fills: int = 16,
                   width: int = DEFAULT_WIDTH, shards: int = 1,
-                  batch: int = 512) -> dict:
+                  batch: int = DEFAULT_LATENCY_BATCH) -> dict:
     """Streaming latency (BASELINE.md p99 column): the stream is served
     in micro-batches of `batch` messages through process_wire; a
     message's fill latency is bounded by its batch's wall time, so the
@@ -292,11 +304,16 @@ def main(argv=None) -> int:
     p.add_argument("--width", type=int, default=DEFAULT_WIDTH,
                    help="active-lane compaction: messages per scan step "
                         "(0 = full-width)")
+    p.add_argument("--workload", choices=("zipf", "cancel"), default="zipf",
+                   help="lanes-suite stream: Zipf-skewed or bursty "
+                        "cancel/replace (BASELINE.md rows)")
     p.add_argument("--parity-prefix", type=int, default=2000,
                    help="post-preamble messages checked against the oracle")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="dump a jax.profiler trace of the timed run to DIR")
-    p.add_argument("--batch", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=DEFAULT_LATENCY_BATCH,
+                   help="micro-batch size (latency suite batches; parity "
+                        "suite scan length)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--compat", choices=("java", "fixed"), default="java")
     args = p.parse_args(argv)
@@ -306,7 +323,8 @@ def main(argv=None) -> int:
                                 steps=args.steps, slots=args.slots,
                                 max_fills=args.max_fills, shards=args.shards,
                                 parity_prefix=args.parity_prefix,
-                                width=args.width, profile_dir=args.profile)
+                                width=args.width, workload=args.workload,
+                                profile_dir=args.profile)
     elif args.suite == "latency":
         rec = bench_latency(args.events or 20_000, args.symbols,
                             args.accounts, args.seed, args.zipf,

@@ -19,6 +19,7 @@ sharded build is a thin shard_map wrapper around engine/lanes.py.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import numpy as np
@@ -40,7 +41,11 @@ def _shard_map(fn, mesh, in_specs, out_specs):
     return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 
+@functools.lru_cache(maxsize=None)
 def build_mesh(shards: int) -> Mesh:
+    """One Mesh per shard count per process — sessions share it, so the
+    jitted sharded builders below cache across sessions exactly like the
+    single-device build_lane_chunk lru_cache."""
     devs = jax.devices()
     if len(devs) < shards:
         raise ValueError(
@@ -129,6 +134,20 @@ def build_sharded_settle(cfg: L.LaneConfig, mesh: Mesh):
     st_specs = state_specs(L.make_lane_state(cfg))
     return _shard_map(settle, mesh, (st_specs, P(), P(), P()),
                       (st_specs, P()))
+
+
+@functools.lru_cache(maxsize=None)
+def build_sharded_chunk_jit(cfg: L.LaneConfig, shards: int, T: int, M: int):
+    """Jitted sharded chunk with state donation, cached per static shape
+    at MODULE level — sharded sessions share compiled executables."""
+    mesh = build_mesh(shards)
+    return jax.jit(build_sharded_chunk(cfg, mesh, T, M), donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def build_sharded_settle_jit(cfg: L.LaneConfig, shards: int):
+    mesh = build_mesh(shards)
+    return jax.jit(build_sharded_settle(cfg, mesh), donate_argnums=(0,))
 
 
 def shard_state(state, mesh: Mesh):

@@ -88,14 +88,12 @@ class LaneSession:
         self.dev_cfg = (dataclasses.replace(cfg, lanes=cfg.lanes + 1,
                                             width=W) if W else cfg)
         self.shards = shards
-        self._chunk_cache: Dict[tuple, object] = {}
         if shards > 1:
             from kme_tpu.parallel import mesh as M
 
             self.mesh = M.build_mesh(shards)
             self.state = M.shard_state(L.make_lane_state(cfg), self.mesh)
-            self._settle = jax.jit(M.build_sharded_settle(cfg, self.mesh),
-                                   donate_argnums=(0,))
+            self._settle = M.build_sharded_settle_jit(cfg, shards)
         else:
             self.mesh = None
             self.state = L.make_lane_state(self.dev_cfg)
@@ -108,15 +106,9 @@ class LaneSession:
     def _chunk_fn(self, T: int, M: int):
         if self.shards == 1:
             return L.build_lane_chunk(self.dev_cfg, T, M)
-        key = (T, M)
-        fn = self._chunk_cache.get(key)
-        if fn is None:
-            from kme_tpu.parallel import mesh as MM
+        from kme_tpu.parallel import mesh as MM
 
-            raw = MM.build_sharded_chunk(self.cfg, self.mesh, T, M)
-            fn = jax.jit(raw, donate_argnums=(0,))
-            self._chunk_cache[key] = fn
-        return fn
+        return MM.build_sharded_chunk_jit(self.cfg, self.shards, T, M)
 
     def _pack_window(self, cols: Dict[str, np.ndarray], widx: np.ndarray,
                      t0: int, T: int, M: int) -> Dict[str, np.ndarray]:

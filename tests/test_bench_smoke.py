@@ -30,6 +30,26 @@ def test_bench_lane_engine_smoke(cpu_devices):
     assert set(("plan_s", "dispatch_s", "fetch_s", "recon_s")) <= set(d)
 
 
+def test_bench_cancel_workload_and_latency_suite_smoke(cpu_devices):
+    """The other two bench entry points at small scale: the cancel-heavy
+    lanes workload and the streaming-latency suite."""
+    from kme_tpu.benchmarks import bench_latency
+
+    rec = bench_lane_engine(events=600, symbols=8, accounts=32, seed=5,
+                            steps=8, slots=32, max_fills=16,
+                            parity_prefix=200, workload="cancel")
+    assert rec["detail"]["workload"] == "cancel"
+    assert rec["value"] > 0
+
+    rec = bench_latency(events=600, symbols=8, accounts=32, seed=5,
+                        slots=32, max_fills=16, batch=256)
+    assert rec["metric"] == "p99_batch_latency_ms"
+    assert rec["value"] > 0
+    d = rec["detail"]
+    assert d["batches"] == (600 + 2 * 32 + 8 + 255) // 256
+    assert d["p50_ms"] <= d["p99_ms"] <= d["max_ms"]
+
+
 def test_capacity_envelope_book_full_rejects_per_message(cpu_devices):
     """H2 policy: overflowing a book side rejects THAT message only —
     the batch continues and stays oracle-exact (no sticky poison)."""
