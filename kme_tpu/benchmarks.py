@@ -57,7 +57,7 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
                       slots: int = 128, max_fills: int = 16,
                       shards: int = 1, parity_prefix: int = 2000,
                       width: int = DEFAULT_WIDTH,
-                      workload: str = "zipf",
+                      workload: str = "zipf", window: int = 1024,
                       profile_dir: str = None) -> dict:
     """End-to-end lane-engine throughput (see module docstring).
     workload: 'zipf' (the headline row) or 'cancel' (the bursty
@@ -69,7 +69,7 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
     from kme_tpu.workload import cancel_heavy_stream, zipf_symbol_stream
 
     cfg = LaneConfig(lanes=symbols, slots=slots, accounts=accounts,
-                     max_fills=max_fills, steps=steps)
+                     max_fills=max_fills, steps=steps, window=window)
     if workload == "cancel":
         msgs = cancel_heavy_stream(events, num_symbols=symbols,
                                    num_accounts=accounts, seed=seed)
@@ -307,6 +307,8 @@ def main(argv=None) -> int:
     p.add_argument("--workload", choices=("zipf", "cancel"), default="zipf",
                    help="lanes-suite stream: Zipf-skewed or bursty "
                         "cancel/replace (BASELINE.md rows)")
+    p.add_argument("--window", type=int, default=1024,
+                   help="max scan steps per dispatch window")
     p.add_argument("--parity-prefix", type=int, default=2000,
                    help="post-preamble messages checked against the oracle")
     p.add_argument("--profile", default=None, metavar="DIR",
@@ -324,6 +326,7 @@ def main(argv=None) -> int:
                                 max_fills=args.max_fills, shards=args.shards,
                                 parity_prefix=args.parity_prefix,
                                 width=args.width, workload=args.workload,
+                                window=args.window,
                                 profile_dir=args.profile)
     elif args.suite == "latency":
         rec = bench_latency(args.events or 20_000, args.symbols,
