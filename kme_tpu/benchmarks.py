@@ -185,6 +185,44 @@ def _est_step_bytes(S, A, N, E, W) -> int:
     return pos + rows + fills
 
 
+def bench_native_engine(events: int = 100_000, seed: int = 0,
+                        batch: int = 8192, compat: str = "java") -> dict:
+    """Quirk-exact throughput of the NATIVE C++ engine on the stock
+    harness workload — the fast java-compat serving path (COMPAT.md:
+    quirk-exact parallelism is impossible under Q11, so this host-native
+    engine plays the role the reference's own JVM stack plays)."""
+    from kme_tpu.native.oracle import NativeOracleEngine
+    from kme_tpu.workload import harness_stream
+
+    msgs = harness_stream(events, seed=seed)
+    if len(msgs) <= batch:
+        raise ValueError(
+            f"events ({len(msgs)} incl. preamble) must exceed the warmup "
+            f"batch ({batch}) — nothing would be timed")
+    eng = NativeOracleEngine(compat)
+    eng.process_wire(msgs[:batch])  # warmup (allocator, caches)
+    t0 = time.perf_counter()
+    nlines = 0
+    for lo in range(batch, len(msgs), batch):
+        out = eng.process_wire(msgs[lo:lo + batch])
+        nlines += sum(len(x) for x in out)
+    dt = time.perf_counter() - t0
+    n = len(msgs) - batch
+    ops = n / dt
+    return {
+        "metric": "orders_per_sec_native_quirk_exact",
+        "value": round(ops, 1),
+        "unit": "orders/s",
+        "vs_baseline": round(ops / REFERENCE_BASELINE_OPS, 3),
+        "detail": {
+            "events": n, "seconds": round(dt, 3), "batch": batch,
+            "compat": compat, "out_lines": nlines,
+            "engine": "native C++ (kme_tpu/native/kme_oracle.cpp)",
+            "baseline_assumption_ops": REFERENCE_BASELINE_OPS,
+        },
+    }
+
+
 def bench_parity_engine(events: int = 4096, seed: int = 0, batch: int = 2048,
                         compat: str = "java") -> dict:
     """Throughput of the serial device parity engine on the stock harness
@@ -288,7 +326,8 @@ def main(argv=None) -> int:
     import argparse
 
     p = argparse.ArgumentParser(prog="kme-bench")
-    p.add_argument("--suite", choices=("lanes", "parity", "latency"),
+    p.add_argument("--suite", choices=("lanes", "parity", "native",
+                                       "latency"),
                    default="lanes")
     p.add_argument("--events", type=int, default=None)
     p.add_argument("--symbols", type=int, default=1024)
@@ -328,6 +367,9 @@ def main(argv=None) -> int:
                                 width=args.width, workload=args.workload,
                                 window=args.window,
                                 profile_dir=args.profile)
+    elif args.suite == "native":
+        rec = bench_native_engine(args.events or 100_000, args.seed,
+                                  max(args.batch, 1), args.compat)
     elif args.suite == "latency":
         rec = bench_latency(args.events or 20_000, args.symbols,
                             args.accounts, args.seed, args.zipf,

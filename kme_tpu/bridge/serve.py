@@ -17,7 +17,11 @@ import sys
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kme-serve", description=__doc__)
     p.add_argument("--listen", default="127.0.0.1:9092", metavar="HOST:PORT")
-    p.add_argument("--engine", choices=("lanes", "oracle"), default="lanes")
+    p.add_argument("--engine", choices=("lanes", "oracle", "native"),
+                   default="lanes",
+                   help="lanes = device throughput engine (fixed mode); "
+                        "native = C++ quirk-exact engine (fast java "
+                        "compat); oracle = Python reference replica")
     p.add_argument("--compat", choices=("java", "fixed"), default="fixed")
     p.add_argument("--batch", type=int, default=1024,
                    help="max records per engine micro-batch")

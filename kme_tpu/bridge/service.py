@@ -13,6 +13,9 @@ contract is a poll loop over the broker API with a pluggable engine:
   produced.
 - engine="oracle" — the scalar reference replica (compat java|fixed),
   quirk-exact per message; the slow-but-byte-faithful configuration.
+- engine="native" — the C++ port of the same quirk-exact semantics
+  (kme_tpu/native/oracle.py): the FAST java-compat path (the parallel
+  engine cannot be quirk-exact under Q11 — COMPAT.md).
 
 Malformed values (JSON Jackson would reject) kill the reference's
 stream thread (KProcessor.java:513-517); the service instead drops the
@@ -38,16 +41,22 @@ class MatchService:
                  strict: bool = False,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 4096) -> None:
-        if engine not in ("lanes", "oracle"):
+        if engine not in ("lanes", "oracle", "native"):
             raise ValueError(f"unknown engine {engine!r}")
         if engine == "lanes" and compat != "fixed":
             raise ValueError("the lanes engine is fixed-mode only; "
-                             "use engine='oracle' for compat='java'")
+                             "use engine='oracle'/'native' for "
+                             "compat='java'")
+        if engine == "native" and checkpoint_dir is not None:
+            raise ValueError("checkpointing is not yet supported for the "
+                             "native engine (use engine='oracle' or "
+                             "'lanes')")
         self.broker = broker
         self.engine_kind = engine
         self.batch = batch
         self.strict = strict
         self.offset = 0
+        self._session = self._oracle = self._native = None
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
         self._last_ckpt_offset = 0
@@ -65,11 +74,15 @@ class MatchService:
             cfg = LaneConfig(lanes=symbols, slots=slots, accounts=accounts,
                              max_fills=max_fills)
             self._session = LaneSession(cfg, shards=shards, width=width)
-            self._oracle = None
+        elif engine == "native":
+            from kme_tpu.native.oracle import NativeOracleEngine
+
+            kw = ({"book_slots": slots, "max_fills": max_fills}
+                  if compat == "fixed" else {})
+            self._native = NativeOracleEngine(compat, **kw)
         elif engine == "oracle":
             from kme_tpu.oracle import OracleEngine
 
-            self._session = None
             # the capacity envelope is a fixed-mode concept; java compat
             # replicates the reference's unbounded stores
             kw = ({"book_slots": slots, "max_fills": max_fills}
@@ -102,7 +115,7 @@ class MatchService:
                     f"snapshot in {self.checkpoint_dir} has capacity "
                     f"config {have}, but {want} was requested — capacity "
                     f"changes need a state migration, not a resume")
-            self._session, self._oracle = ses, None
+            self._session = ses
         else:
             ora, offset = ck.load_oracle(self.checkpoint_dir)
             if ora is None:
@@ -113,7 +126,7 @@ class MatchService:
                     f"snapshot in {self.checkpoint_dir} was taken with "
                     f"compat={snap_compat!r}, but compat={compat!r} was "
                     f"requested")
-            self._session, self._oracle = None, ora
+            self._oracle = ora
         self.offset = self._last_ckpt_offset = offset
         print(f"kme-serve: resumed from snapshot at offset {offset}",
               file=sys.stderr)
@@ -173,7 +186,17 @@ class MatchService:
             if m is not None:
                 msgs.append(m)
         if msgs:
-            if self._session is not None:
+            if self._native is not None:
+                # byte-faithful death handling: forward every completed
+                # message's records, THEN die like the reference thread
+                out, exc = self._native.process_wire_partial(msgs)
+                for lines in out:
+                    for ln in lines:
+                        key, _, value = ln.partition(" ")
+                        self.broker.produce(TOPIC_OUT, key, value)
+                if exc is not None:
+                    raise exc
+            elif self._session is not None:
                 for lines in self._session.process_wire(msgs):
                     for ln in lines:
                         key, _, value = ln.partition(" ")

@@ -18,14 +18,16 @@ import tempfile
 from typing import Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "kme_host.cpp")
+_SRCS = (os.path.join(_HERE, "kme_host.cpp"),
+         os.path.join(_HERE, "kme_oracle.cpp"))
 
 _lib = None
 _lib_tried = False
 
 
-def _build(src: str, out: str) -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", out]
+def _build(srcs, out: str) -> bool:
+    cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17"] + list(srcs)
+           + ["-o", out])
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
     except (OSError, subprocess.TimeoutExpired) as e:
@@ -49,9 +51,15 @@ def load_library() -> Optional[ctypes.CDLL]:
     if os.environ.get("KME_NATIVE", "1") == "0":
         return None
     try:
-        with open(_SRC, "rb") as f:
-            tag = hashlib.sha256(f.read()).hexdigest()[:16]
-    except OSError:
+        h = hashlib.sha256()
+        for src in _SRCS:
+            with open(src, "rb") as f:
+                h.update(f.read())
+        tag = h.hexdigest()[:16]
+    except OSError as e:
+        print(f"kme_tpu.native: source unreadable ({e}); the native "
+              f"runtime is DISABLED — using the pure-Python fallbacks",
+              file=sys.stderr)
         return None
     build_dir = os.path.join(_HERE, "_build")
     so_path = os.path.join(build_dir, f"kme_host_{tag}.so")
@@ -62,7 +70,7 @@ def load_library() -> Optional[ctypes.CDLL]:
             # race benignly (os.replace is atomic)
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=build_dir)
             os.close(fd)
-            built = _build(_SRC, tmp)
+            built = _build(_SRCS, tmp)
             if built:
                 os.replace(tmp, so_path)
             else:
@@ -122,6 +130,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "kme_sched_import_accounts": ([c.c_void_p, c.c_int64, P64, P32], None),
         "kme_sched_import_symbols": ([c.c_void_p, c.c_int64, P64, P32], None),
         "kme_sched_import_routes": ([c.c_void_p, c.c_int64, P64, P64], None),
+        # native quirk-exact engine (kme_oracle.cpp)
+        "kme_oracle_new": ([c.c_int32, c.c_int32, c.c_int64, c.c_int32,
+                            c.c_int64], c.c_void_p),
+        "kme_oracle_free": ([c.c_void_p], None),
+        "kme_oracle_process": ([c.c_void_p, c.c_int64] + [P64] * 6
+                               + [P64, c.POINTER(c.c_uint8),
+                                  P64, c.POINTER(c.c_uint8)], c.c_int32),
+        "kme_oracle_err_index": ([c.c_void_p], c.c_int64),
+        "kme_oracle_err_msg": ([c.c_void_p], c.c_char_p),
+        "kme_oracle_out_buf": ([c.c_void_p], c.c_void_p),
+        "kme_oracle_out_len": ([c.c_void_p], c.c_int64),
+        "kme_oracle_line_counts": ([c.c_void_p], P64),
+        "kme_oracle_n_processed": ([c.c_void_p], c.c_int64),
+        "kme_oracle_dump_state": ([c.c_void_p], c.c_char_p),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)

@@ -11,12 +11,13 @@ import subprocess
 import sys
 import time
 
+import kme_tpu.opcodes as op
 from kme_tpu.bridge.broker import InProcessBroker
 from kme_tpu.bridge.consume import consume_lines
 from kme_tpu.bridge.provision import provision
 from kme_tpu.bridge.service import TOPIC_IN, TOPIC_OUT, MatchService
 from kme_tpu.oracle import OracleEngine
-from kme_tpu.wire import dumps_order
+from kme_tpu.wire import OrderMsg, dumps_order
 from kme_tpu.workload import harness_stream
 
 
@@ -60,6 +61,50 @@ def test_bridge_e2e_lanes_engine_fixed():
     assert svc.run(max_messages=len(msgs)) == len(msgs)
     got = list(consume_lines(broker, follow=False))
     assert got == _oracle_lines(msgs, "fixed", book_slots=64, max_fills=32)
+
+
+def test_bridge_e2e_native_engine_quirk_exact():
+    """Stock harness through the native C++ engine service: byte-
+    identical MatchOut stream (the fast java-compat serving path)."""
+    import pytest
+
+    nat = pytest.importorskip("kme_tpu.native.oracle")
+    if not nat.native_available():
+        pytest.skip("native library unavailable")
+    broker = InProcessBroker()
+    provision(broker)
+    msgs = harness_stream(600, seed=21)
+    _pump(broker, msgs)
+    svc = MatchService(broker, engine="native", compat="java", batch=128)
+    assert svc.run(max_messages=len(msgs)) == len(msgs)
+    got = list(consume_lines(broker, follow=False))
+    assert got == _oracle_lines(msgs, "java")
+
+
+def test_bridge_native_engine_death_forwards_prefix():
+    """A reference-death message mid-batch: every record of the earlier
+    messages reaches MatchOut BEFORE the service dies (the reference
+    forwards per record; its thread dies on the poisoned one)."""
+    import pytest
+
+    nat = pytest.importorskip("kme_tpu.native.oracle")
+    if not nat.native_available():
+        pytest.skip("native library unavailable")
+    from kme_tpu.oracle.engine import ReferenceHang
+
+    broker = InProcessBroker()
+    provision(broker)
+    msgs = [OrderMsg(action=op.CREATE_BALANCE, aid=1),
+            OrderMsg(action=op.TRANSFER, aid=1, size=100000),
+            OrderMsg(action=op.ADD_SYMBOL, sid=1),
+            OrderMsg(action=op.BUY, oid=5, aid=1, sid=1, price=50, size=3),
+            OrderMsg(action=op.REMOVE_SYMBOL, sid=1)]  # Q4 hang
+    _pump(broker, msgs)
+    svc = MatchService(broker, engine="native", compat="java", batch=64)
+    with pytest.raises(ReferenceHang):
+        svc.run(max_messages=len(msgs))
+    got = list(consume_lines(broker, follow=False))
+    assert got == _oracle_lines(msgs[:4], "java")
 
 
 def test_bridge_malformed_record_policy():

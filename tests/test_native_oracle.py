@@ -1,0 +1,107 @@
+"""Native C++ quirk-exact engine vs the Python oracle (the authority).
+
+Byte parity on the wire-line stream AND deep equality of all five
+stores, across both compat modes, the capacity envelope, multi-batch
+continuation, and reference-death paths."""
+
+import pytest
+
+import kme_tpu.opcodes as op
+from kme_tpu.oracle import OracleEngine
+from kme_tpu.oracle.engine import ReferenceHang
+from kme_tpu.wire import OrderMsg
+from kme_tpu.workload import (cancel_heavy_stream, harness_stream,
+                              zipf_symbol_stream)
+
+native = pytest.importorskip("kme_tpu.native.oracle")
+if not native.native_available():
+    pytest.skip("native library unavailable (no toolchain)",
+                allow_module_level=True)
+
+
+def _oracle_state(ora):
+    orders = {oid: {"action": r.action, "aid": r.aid, "sid": r.sid,
+                    "price": r.price, "size": r.size, "next": r.next,
+                    "prev": r.prev}
+              for oid, r in ora.orders.items()}
+    return {"balances": dict(ora.balances), "positions": dict(ora.positions),
+            "orders": orders, "books": dict(ora.books),
+            "buckets": dict(ora.buckets)}
+
+
+def assert_native_parity(msgs, compat, batch=None, **envelope):
+    ora = OracleEngine(compat, **envelope)
+    nat = native.NativeOracleEngine(compat, **envelope)
+    want = [[r.wire() for r in ora.process(m.copy())] for m in msgs]
+    if batch is None:
+        got = nat.process_wire([m.copy() for m in msgs])
+    else:
+        got = []
+        for lo in range(0, len(msgs), batch):
+            got.extend(nat.process_wire(
+                [m.copy() for m in msgs[lo:lo + batch]]))
+    for i in range(len(msgs)):
+        assert got[i] == want[i], f"diverged at message {i}: {msgs[i]}"
+    assert nat.export_state() == _oracle_state(ora)
+
+
+def test_native_java_harness_quirk_exact():
+    """Stock harness (Q1 sid=0 trading, Q2 ghost trades, Q5 payout-as-
+    cancel, Q9 echoes, Q11 garbage positions) — byte and store parity."""
+    assert_native_parity(harness_stream(3000, seed=7), "java")
+
+
+def test_native_java_harness_second_seed_multibatch():
+    assert_native_parity(harness_stream(2000, seed=123), "java", batch=333)
+
+
+def test_native_fixed_with_envelope():
+    msgs = harness_stream(2000, seed=5, num_symbols=4, num_accounts=8,
+                          payout_opcode_bug=False, validate=True)
+    assert_native_parity(msgs, "fixed", book_slots=16, max_fills=8)
+
+
+def test_native_fixed_zipf_with_barriers():
+    msgs = zipf_symbol_stream(2000, num_symbols=16, num_accounts=24, seed=11,
+                              zipf_a=1.0, payout_per_mille=5)
+    assert_native_parity(msgs, "fixed")
+
+
+def test_native_fixed_cancel_heavy():
+    msgs = cancel_heavy_stream(2000, num_symbols=8, num_accounts=16, seed=3)
+    assert_native_parity(msgs, "fixed")
+
+
+def test_native_reference_hang_death_path():
+    """Q4: REMOVE_SYMBOL on a non-empty book hangs the reference — both
+    engines raise ReferenceHang at the same message with the same state."""
+    msgs = [OrderMsg(action=op.CREATE_BALANCE, aid=1),
+            OrderMsg(action=op.TRANSFER, aid=1, size=100000),
+            OrderMsg(action=op.ADD_SYMBOL, sid=1),
+            OrderMsg(action=op.BUY, oid=5, aid=1, sid=1, price=50, size=3)]
+    kill = OrderMsg(action=op.REMOVE_SYMBOL, sid=1)
+    ora = OracleEngine("java")
+    nat = native.NativeOracleEngine("java")
+    for m in msgs:
+        ora.process(m.copy())
+    nat.process_wire([m.copy() for m in msgs])
+    with pytest.raises(ReferenceHang):
+        ora.process(kill.copy())
+    with pytest.raises(ReferenceHang):
+        nat.process_wire([kill.copy()])
+    assert nat.export_state() == _oracle_state(ora)
+
+
+def test_native_wire_pointer_fields_roundtrip():
+    """Messages arriving with non-null next/prev enter the engine with
+    them set (Jackson field binding) and echo/rest verbatim (Q9).
+    (Cancelling such a poisoned order dies in BOTH engines — the oracle
+    with a raw KeyError at the dangling prev, the native engine with
+    ReferenceCrash — so the comparison stops at the rest/echo.)"""
+    msgs = [OrderMsg(action=op.CREATE_BALANCE, aid=1),
+            OrderMsg(action=op.TRANSFER, aid=1, size=100000),
+            OrderMsg(action=op.ADD_SYMBOL, sid=1),
+            OrderMsg(action=op.BUY, oid=5, aid=1, sid=1, price=50, size=3,
+                     next=777, prev=888),
+            OrderMsg(action=op.BUY, oid=6, aid=1, sid=1, price=50, size=2)]
+    assert_native_parity(msgs, "java")
