@@ -37,25 +37,38 @@ DEFAULT_LATENCY_BATCH = 2048
 def _assert_parity_prefix(msgs, cfg, shards, prefix: int,
                           width: int) -> None:
     """Replay `prefix` messages through a throwaway session and the
-    scalar oracle (with the matching capacity envelope); require
-    byte-identical wire streams."""
-    from kme_tpu.oracle import OracleEngine
+    quirk-exact reference replica (with the matching capacity envelope);
+    require byte-identical wire streams. Uses the native C++ replica
+    when available (itself pinned byte+store-exact against the Python
+    oracle by tests/test_native_oracle.py); falls back to the Python
+    oracle otherwise."""
     from kme_tpu.runtime.session import LaneSession
 
     ses = LaneSession(cfg, shards=shards, width=width)
-    ora = OracleEngine("fixed", book_slots=cfg.slots,
-                       max_fills=cfg.max_fills)
+    kw = dict(book_slots=cfg.slots, max_fills=cfg.max_fills)
+    try:
+        from kme_tpu.native.oracle import NativeOracleEngine, native_available
+
+        assert native_available()
+        judge = NativeOracleEngine("fixed", **kw)
+        want = judge.process_wire([m.copy() for m in msgs[:prefix]])
+    except Exception:
+        from kme_tpu.oracle import OracleEngine
+
+        ora = OracleEngine("fixed", **kw)
+        want = [[r.wire() for r in ora.process(msgs[i].copy())]
+                for i in range(prefix)]
     got = ses.process_wire(msgs[:prefix])
     for i in range(prefix):
-        want = [r.wire() for r in ora.process(msgs[i].copy())]
-        assert got[i] == want, f"bench parity prefix diverged at message {i}"
+        assert got[i] == want[i], \
+            f"bench parity prefix diverged at message {i}"
 
 
 def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
                       accounts: int = 2048, seed: int = 0,
                       zipf_a: float = 1.2, steps: int = 64,
                       slots: int = 128, max_fills: int = 16,
-                      shards: int = 1, parity_prefix: int = 2000,
+                      shards: int = 1, parity_prefix: int = 20000,
                       width: int = DEFAULT_WIDTH,
                       workload: str = "zipf", window: int = 1024,
                       profile_dir: str = None) -> dict:
@@ -348,8 +361,9 @@ def main(argv=None) -> int:
                         "cancel/replace (BASELINE.md rows)")
     p.add_argument("--window", type=int, default=1024,
                    help="max scan steps per dispatch window")
-    p.add_argument("--parity-prefix", type=int, default=2000,
-                   help="post-preamble messages checked against the oracle")
+    p.add_argument("--parity-prefix", type=int, default=20000,
+                   help="post-preamble messages checked against the "
+                        "quirk-exact replica in-run")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="dump a jax.profiler trace of the timed run to DIR")
     p.add_argument("--batch", type=int, default=DEFAULT_LATENCY_BATCH,

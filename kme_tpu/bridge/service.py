@@ -152,11 +152,21 @@ class MatchService:
     # ------------------------------------------------------------------
 
     def _parse(self, value: str):
+        from kme_tpu.runtime.sequencer import EnvelopeError
         from kme_tpu.wire import parse_order
 
         try:
-            return parse_order(value)
-        except ValueError:
+            m = parse_order(value)
+            # the Jackson envelope: price/size are Java int fields, so
+            # out-of-int32 values kill the reference's deserializer
+            # (KProcessor.java:513-517) exactly like non-JSON input —
+            # same drop/strict policy, for every engine
+            if not (-2**31 <= m.price < 2**31 and -2**31 <= m.size < 2**31):
+                raise EnvelopeError(
+                    f"price/size outside int32 (price={m.price}, "
+                    f"size={m.size})")
+            return m
+        except (ValueError, EnvelopeError):
             if self.strict:
                 raise
             print(f"kme-serve: dropping malformed record: {value[:120]!r}",

@@ -18,6 +18,7 @@
 // platform, so the overshoot behavior matches the oracle bit-for-bit
 // (tests sweep the full 126-bit range plus overshoot points).
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
@@ -116,7 +117,11 @@ struct PairHash {
 };
 
 using PosKey = std::pair<int64_t, int64_t>;       // (aid, sid)
-using PosVal = std::pair<int64_t, int64_t>;       // (amount, available)
+
+struct PosVal {  // (amount, available) + insertion stamp: the Python
+  int64_t first, second;  // oracle's dict iterates in INSERTION order,
+  uint64_t seq = 0;       // which is observable on payout death paths
+};
 
 struct Death {  // ReferenceHang / ReferenceCrash surfaced as codes
   int32_t code;
@@ -130,6 +135,19 @@ struct Engine {
 
   std::unordered_map<int64_t, int64_t> balances;
   std::unordered_map<PosKey, PosVal, PairHash> positions;
+  uint64_t pos_seq = 0;
+
+  // dict semantics: overwriting an existing key keeps its position;
+  // a fresh insert (including delete-then-reinsert) goes to the end
+  void put_pos(const PosKey& k, int64_t amount, int64_t available) {
+    auto it = positions.find(k);
+    if (it != positions.end()) {
+      it->second.first = amount;
+      it->second.second = available;
+    } else {
+      positions[k] = PosVal{amount, available, ++pos_seq};
+    }
+  }
   std::unordered_map<int64_t, StoredOrder> orders;
   std::unordered_map<int64_t, Book> books;
   std::unordered_map<int64_t, Bucket> buckets;
@@ -274,20 +292,25 @@ struct Engine {
     if (!remove_symbol(sid)) return false;
     int64_t match_sid = java ? sid : (sid < 0 ? jneg(sid) : sid);
     bool credit = java || sid >= 0;
-    std::vector<PosKey> to_remove;
-    for (auto& kv : positions) {
-      if (kv.first.second == match_sid) {
-        if (credit) {
-          auto bit = balances.find(kv.first.first);
-          if (bit == balances.end())
-            throw Death{ERR_CRASH,
-                        "NPE: payout credits account with no balance"};
-          bit->second = jadd(bit->second, jmul(kv.second.first, size));
-        }
-        to_remove.push_back(kv.first);
+    // iterate matches in INSERTION order (the Python oracle's dict
+    // order): on a mid-scan ReferenceCrash the set of balances already
+    // credited is part of the state-at-death contract
+    std::vector<std::pair<uint64_t, PosKey>> matches;
+    for (auto& kv : positions)
+      if (kv.first.second == match_sid)
+        matches.push_back({kv.second.seq, kv.first});
+    std::sort(matches.begin(), matches.end());
+    for (auto& m : matches) {
+      if (credit) {
+        auto pit = positions.find(m.second);
+        auto bit = balances.find(m.second.first);
+        if (bit == balances.end())
+          throw Death{ERR_CRASH,
+                      "NPE: payout credits account with no balance"};
+        bit->second = jadd(bit->second, jmul(pit->second.first, size));
       }
     }
-    for (auto& k : to_remove) positions.erase(k);
+    for (auto& m : matches) positions.erase(m.second);
     return true;
   }
 
@@ -313,7 +336,7 @@ struct Engine {
     if (adj != 0) {
       if (pit == positions.end())
         throw Death{ERR_CRASH, "NPE: checkBalance adj-write with no position"};
-      pit->second = {pit->second.first, jadd(available, jneg(adj))};
+      pit->second.second = jadd(available, jneg(adj));
     }
     return true;
   }
@@ -343,7 +366,7 @@ struct Engine {
                     "NPE: postRemoveAdjustments adj-write with no position"};
       PosKey target = java ? PosKey{pos.first, pos.second}
                            : PosKey{rec.aid, rec.sid};  // Q11
-      positions[target] = {pos.first, jadd(pos.second, adj)};
+      put_pos(target, pos.first, jadd(pos.second, adj));
     }
   }
 
@@ -378,7 +401,7 @@ struct Engine {
     PosKey key{aid, sid};
     auto pit = positions.find(key);
     if (pit == positions.end()) {
-      positions[key] = {size, size};
+      put_pos(key, size, size);
     } else {
       PosVal pos = pit->second;
       int64_t new_amount = jadd(pos.first, size);
@@ -386,7 +409,7 @@ struct Engine {
       if (new_amount == 0) {
         positions.erase(target);
       } else {
-        positions[target] = {new_amount, jadd(pos.second, size)};
+        put_pos(target, new_amount, jadd(pos.second, size));
       }
     }
     auto bit = balances.find(aid);
@@ -588,6 +611,7 @@ struct Engine {
       return;
     }
     Echo orig = cur;
+    uint64_t s_seq = pos_seq;
     auto s_bal = balances;
     auto s_pos = positions;
     auto s_ord = orders;
@@ -616,6 +640,7 @@ struct Engine {
       }
     }
     if (!violated) return;
+    pos_seq = s_seq;
     balances = std::move(s_bal);
     positions = std::move(s_pos);
     orders = std::move(s_ord);

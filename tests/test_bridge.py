@@ -107,6 +107,37 @@ def test_bridge_native_engine_death_forwards_prefix():
     assert got == _oracle_lines(msgs[:4], "java")
 
 
+def test_bridge_envelope_overflow_record_policy():
+    """A wire-parseable record with price/size outside int32 is outside
+    the Jackson envelope (Java int fields — the reference's deserializer
+    dies on it): same drop/strict policy as non-JSON, for EVERY engine,
+    and the stream continues past it."""
+    for engine, compat in (("oracle", "java"), ("native", "java"),
+                           ("lanes", "fixed")):
+        if engine == "native":
+            import pytest
+
+            nat = pytest.importorskip("kme_tpu.native.oracle")
+            if not nat.native_available():
+                continue
+        broker = InProcessBroker()
+        provision(broker)
+        good1 = '{"action":100,"aid":1}'
+        poison = '{"action":2,"oid":1,"aid":1,"sid":1,"price":4294967296,"size":1}'
+        good2 = '{"action":101,"aid":1,"size":5}'
+        for v in (good1, poison, good2):
+            broker.produce(TOPIC_IN, None, v)
+        svc = MatchService(broker, engine=engine, compat=compat, batch=16,
+                           symbols=4, accounts=8)
+        assert svc.run(max_messages=3) == 3
+        got = list(consume_lines(broker, follow=False))
+        from kme_tpu.wire import parse_order
+
+        want = _oracle_lines([parse_order(good1), parse_order(good2)],
+                             compat)
+        assert got == want, f"engine={engine}"
+
+
 def test_bridge_malformed_record_policy():
     """Bad JSON is dropped (non-strict) or raises (strict — the
     reference serde kills the stream thread, KProcessor.java:513-517)."""

@@ -15,6 +15,12 @@ from kme_tpu.workload import (cancel_heavy_stream, harness_stream,
 
 native = pytest.importorskip("kme_tpu.native.oracle")
 if not native.native_available():
+    import shutil
+
+    if shutil.which("g++"):
+        pytest.fail("g++ is available but the native library failed to "
+                    "build — a real regression, not a missing toolchain "
+                    "(rerun with the kme_tpu.native build stderr)")
     pytest.skip("native library unavailable (no toolchain)",
                 allow_module_level=True)
 
