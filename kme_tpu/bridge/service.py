@@ -47,10 +47,6 @@ class MatchService:
             raise ValueError("the lanes engine is fixed-mode only; "
                              "use engine='oracle'/'native' for "
                              "compat='java'")
-        if engine == "native" and checkpoint_dir is not None:
-            raise ValueError("checkpointing is not yet supported for the "
-                             "native engine (use engine='oracle' or "
-                             "'lanes')")
         self.broker = broker
         self.engine_kind = engine
         self.batch = batch
@@ -116,21 +112,39 @@ class MatchService:
                     f"config {have}, but {want} was requested — capacity "
                     f"changes need a state migration, not a resume")
             self._session = ses
+        elif engine == "native":
+            nat, offset = ck.load_native(self.checkpoint_dir)
+            if nat is None:
+                return False
+            self._check_resume_compat(nat, compat)
+            if not nat.java:
+                want = (self._req_slots, self._req_max_fills)
+                have = (nat.book_slots, nat.max_fills)
+                if want != have:
+                    raise ValueError(
+                        f"snapshot in {self.checkpoint_dir} has envelope "
+                        f"(slots, max_fills)={have}, but {want} was "
+                        f"requested — capacity changes need a state "
+                        f"migration, not a resume")
+            self._native = nat
         else:
             ora, offset = ck.load_oracle(self.checkpoint_dir)
             if ora is None:
                 return False
-            snap_compat = "java" if ora.java else "fixed"
-            if snap_compat != compat:
-                raise ValueError(
-                    f"snapshot in {self.checkpoint_dir} was taken with "
-                    f"compat={snap_compat!r}, but compat={compat!r} was "
-                    f"requested")
+            self._check_resume_compat(ora, compat)
             self._oracle = ora
         self.offset = self._last_ckpt_offset = offset
         print(f"kme-serve: resumed from snapshot at offset {offset}",
               file=sys.stderr)
         return True
+
+    def _check_resume_compat(self, engine_obj, compat: str) -> None:
+        snap_compat = "java" if engine_obj.java else "fixed"
+        if snap_compat != compat:
+            raise ValueError(
+                f"snapshot in {self.checkpoint_dir} was taken with "
+                f"compat={snap_compat!r}, but compat={compat!r} was "
+                f"requested")
 
     def _maybe_checkpoint(self) -> None:
         if self.checkpoint_dir is None:
@@ -145,6 +159,8 @@ class MatchService:
 
         if self._session is not None:
             ck.save_session(self.checkpoint_dir, self._session, self.offset)
+        elif self._native is not None:
+            ck.save_native(self.checkpoint_dir, self._native, self.offset)
         else:
             ck.save_oracle(self.checkpoint_dir, self._oracle, self.offset)
         self._last_ckpt_offset = self.offset

@@ -144,6 +144,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "kme_oracle_line_counts": ([c.c_void_p], P64),
         "kme_oracle_n_processed": ([c.c_void_p], c.c_int64),
         "kme_oracle_dump_state": ([c.c_void_p], c.c_char_p),
+        "kme_oracle_load_state": ([c.c_void_p, c.c_char_p], c.c_int32),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)

@@ -730,9 +730,10 @@ const char* kme_oracle_dump_state(Engine* e) {
     d += buf;
   }
   for (auto& kv : e->positions) {
-    snprintf(buf, sizeof buf, "P %lld %lld %lld %lld\n",
+    snprintf(buf, sizeof buf, "P %lld %lld %lld %lld %llu\n",
              (long long)kv.first.first, (long long)kv.first.second,
-             (long long)kv.second.first, (long long)kv.second.second);
+             (long long)kv.second.first, (long long)kv.second.second,
+             (unsigned long long)kv.second.seq);
     d += buf;
   }
   for (auto& kv : e->books) {
@@ -755,6 +756,74 @@ const char* kme_oracle_dump_state(Engine* e) {
     d += buf;
   }
   return d.c_str();
+}
+
+// restore the five stores from a dump (the checkpoint payload).
+// Returns 0 on success, 1 on a malformed line.
+int32_t kme_oracle_load_state(Engine* e, const char* text) {
+  e->balances.clear();
+  e->positions.clear();
+  e->orders.clear();
+  e->books.clear();
+  e->buckets.clear();
+  e->pos_seq = 0;
+  const char* p = text;
+  while (*p) {
+    const char* nl = strchr(p, '\n');
+    size_t len = nl ? (size_t)(nl - p) : strlen(p);
+    std::string line(p, len);
+    p = nl ? nl + 1 : p + len;
+    if (line.empty()) continue;
+    long long a, b, c, d2, f, g;
+    unsigned long long sq;
+    int nh, ph;
+    switch (line[0]) {
+      case 'B':
+        if (sscanf(line.c_str(), "B %lld %lld", &a, &b) != 2) return 1;
+        e->balances[a] = b;
+        break;
+      case 'P':
+        if (sscanf(line.c_str(), "P %lld %lld %lld %lld %llu", &a, &b, &c,
+                   &d2, &sq) != 5)
+          return 1;
+        e->positions[{a, b}] = PosVal{c, d2, sq};
+        if (sq > e->pos_seq) e->pos_seq = sq;
+        break;
+      case 'K':
+        if (sscanf(line.c_str(), "K %lld %lld %lld", &a, &b, &c) != 3)
+          return 1;
+        e->books[a] = Book{b, c};
+        break;
+      case 'U':
+        if (sscanf(line.c_str(), "U %lld %lld %lld", &a, &b, &c) != 3)
+          return 1;
+        e->buckets[a] = Bucket{b, c};
+        break;
+      case 'O': {
+        long long oid2, prv2;
+        if (sscanf(line.c_str(),
+                   "O %lld %lld %lld %lld %lld %lld %d %lld %d %lld", &oid2,
+                   &a, &b, &c, &d2, &f, &nh, &g, &ph, &prv2) != 10)
+          return 1;
+        StoredOrder r;
+        r.action = a;
+        r.oid = oid2;
+        r.aid = b;
+        r.sid = c;
+        r.price = (int32_t)d2;
+        r.size = (int32_t)f;
+        r.next_has = nh != 0;
+        r.next = g;
+        r.prev_has = ph != 0;
+        r.prev = prv2;
+        e->orders[oid2] = r;
+        break;
+      }
+      default:
+        return 1;
+    }
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -37,6 +37,8 @@ class NativeOracleEngine:
         if compat not in ("java", "fixed"):
             raise ValueError(compat)
         self.java = compat == "java"
+        self.book_slots = book_slots
+        self.max_fills = max_fills
         if self.java and (book_slots is not None or max_fills is not None):
             raise ValueError("capacity envelope is a fixed-mode concept")
         self._lib = load_library()
@@ -131,10 +133,22 @@ class NativeOracleEngine:
             pos += c
         return out, exc
 
+    def dump_state(self) -> str:
+        """The engine's complete store state as the checkpoint text
+        payload (one record per line; includes position insertion
+        stamps so dict iteration order survives a restore)."""
+        return self._lib.kme_oracle_dump_state(self._h).decode()
+
+    def load_state(self, text: str) -> None:
+        """Replace the five stores with a dump_state() payload."""
+        rc = self._lib.kme_oracle_load_state(self._h, text.encode())
+        if rc != 0:
+            raise ValueError("malformed native-engine state payload")
+
     def export_state(self) -> dict:
         """Host dict view of the five stores, comparable to
         OracleEngine's dicts (tests/test_native_oracle.py)."""
-        raw = self._lib.kme_oracle_dump_state(self._h).decode()
+        raw = self.dump_state()
         balances, positions, orders, books, buckets = {}, {}, {}, {}, {}
         for ln in raw.splitlines():
             parts = ln.split()

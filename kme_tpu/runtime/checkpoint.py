@@ -215,6 +215,62 @@ def _restore_one(path: str, shards: Optional[int], width: Optional[int]):
 
 
 # ---------------------------------------------------------------------------
+# native-engine snapshots (text store dump + a JSON header line)
+
+def save_native(ckpt_dir: str, engine, offset: int) -> str:
+    """Snapshot a NativeOracleEngine: JSON header (compat + envelope +
+    offset) on line one, then the store dump."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    header = json.dumps({
+        "version": 1, "kind": "native", "offset": int(offset),
+        "compat": "java" if engine.java else "fixed",
+        "book_slots": engine.book_slots, "max_fills": engine.max_fills,
+    })
+    path = os.path.join(ckpt_dir, f"ckpt-{offset}.nat")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(header + "\n")
+        f.write(engine.dump_state())
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(ckpt_dir)
+    _prune(ckpt_dir, re.compile(r"^ckpt-(\d+)\.nat$"))
+    return path
+
+
+def load_native(ckpt_dir: str):
+    """Returns (engine, offset) or (None, 0); corrupt files fall back."""
+    import sys
+
+    from kme_tpu.native.oracle import NativeOracleEngine
+
+    if not os.path.isdir(ckpt_dir):
+        return None, 0
+    cands = []
+    for name in os.listdir(ckpt_dir):
+        m = re.match(r"^ckpt-(\d+)\.nat$", name)
+        if m:
+            cands.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
+    cands.sort(reverse=True)
+    for offset, path in cands:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                header = json.loads(f.readline())
+                if header.get("version") != 1 or header.get("kind") != "native":
+                    raise ValueError("unsupported snapshot")
+                eng = NativeOracleEngine(header["compat"],
+                                         book_slots=header["book_slots"],
+                                         max_fills=header["max_fills"])
+                eng.load_state(f.read())
+            return eng, offset
+        except Exception as e:
+            print(f"kme_tpu.checkpoint: skipping unreadable snapshot "
+                  f"{path}: {e}", file=sys.stderr)
+    return None, 0
+
+
+# ---------------------------------------------------------------------------
 # oracle-engine snapshots (the scalar replica is plain host state)
 
 def save_oracle(ckpt_dir: str, oracle, offset: int) -> str:
