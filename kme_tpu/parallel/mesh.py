@@ -15,6 +15,16 @@ Layout (SURVEY.md §2.3 "TPU-native equivalent", §7 step 6):
 
 The same step function works single-device (axis_name=None) — the
 sharded build is a thin shard_map wrapper around engine/lanes.py.
+
+Multi-host (DCN) story: the mesh is built from jax.devices(), so under
+`jax.distributed.initialize()` the same code spans hosts — the symbol
+axis lays contiguous lane blocks per process, keeping the per-step
+balance/metric psum on ICI within a slice and crossing DCN only for the
+rare barrier settles and the replicated (A,)-sized merges (the only
+cross-shard traffic this design has; fills ride the GSPMD gather in
+kme_tpu/engine/lanes.py chunk_compaction). Single-process multi-device
+execution is what this environment can validate (8-way virtual mesh in
+tests + the driver dryrun); nothing in the layout is process-local.
 """
 
 from __future__ import annotations
