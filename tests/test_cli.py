@@ -1,0 +1,36 @@
+"""CLI surfaces not covered by the bridge e2e tests: the kme-oracle
+stdin/stdout replica and the loadgen stdout mode."""
+
+import subprocess
+import sys
+
+from kme_tpu.oracle import OracleEngine
+from kme_tpu.wire import dumps_order
+from kme_tpu.workload import harness_stream
+
+
+def test_kme_oracle_pipe_roundtrip():
+    """`kme-loadgen | kme-oracle` reproduces the consumer.js line stream
+    byte-for-byte (the documented manual-verification flow)."""
+    msgs = harness_stream(300, seed=9)
+    stdin = "\n".join(dumps_order(m) for m in msgs) + "\n"
+    r = subprocess.run(
+        [sys.executable, "-m", "kme_tpu.cli", "oracle", "--compat", "java"],
+        input=stdin, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stderr
+    ora = OracleEngine("java")
+    want = [rec.wire() for m in msgs for rec in ora.process(m.copy())]
+    assert r.stdout.splitlines() == want
+
+
+def test_kme_loadgen_stdout_deterministic():
+    out = []
+    for _ in range(2):
+        r = subprocess.run(
+            [sys.executable, "-m", "kme_tpu.cli", "loadgen", "--events",
+             "50", "--seed", "4"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        out.append(r.stdout)
+    assert out[0] == out[1]
+    assert out[0].count('"action"') == len(out[0].splitlines())
