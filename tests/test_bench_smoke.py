@@ -50,6 +50,23 @@ def test_bench_cancel_workload_and_latency_suite_smoke(cpu_devices):
     assert d["p50_ms"] <= d["p99_ms"] <= d["max_ms"]
 
 
+def test_bench_native_suite_smoke():
+    """The native quirk-exact bench entry point at small scale."""
+    import pytest
+
+    nat = pytest.importorskip("kme_tpu.native.oracle")
+    if not nat.native_available():
+        pytest.skip("native library unavailable")
+    from kme_tpu.benchmarks import bench_native_engine
+
+    rec = bench_native_engine(events=3000, batch=1000)
+    assert rec["metric"] == "orders_per_sec_native_quirk_exact"
+    assert rec["value"] > 0
+    assert rec["detail"]["out_lines"] > 0
+    with pytest.raises(ValueError, match="must exceed"):
+        bench_native_engine(events=100, batch=1000)
+
+
 def test_capacity_envelope_book_full_rejects_per_message(cpu_devices):
     """H2 policy: overflowing a book side rejects THAT message only —
     the batch continues and stays oracle-exact (no sticky poison)."""
