@@ -186,7 +186,9 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
             # bandwidth-bound utilization is the honest analog of MFU
             "device_busy_frac": round((t_disp + t_fetch) / total, 3),
             "per_step_us": round(t_disp / max(steps_total, 1) * 1e6, 1),
-            "est_hbm_gbps": round(
+            # MODELED, not measured: derived from the _est_step_bytes
+            # bytes-per-step formula, like baseline_assumption_ops
+            "modeled_hbm_gbps": round(
                 _est_step_bytes(
                     symbols + (1 if shards == 1 and width > 0 else 0),
                     accounts, slots, max_fills,

@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import sys
 import threading
 from typing import Dict, IO, List, Optional
 
@@ -69,22 +70,43 @@ class InProcessBroker:
         return os.path.join(self._persist_dir, f"{name}.log")
 
     def _load_topic(self, name: str) -> None:
+        """Reload a topic log. Committed records are NEVER rewritten: a
+        torn FINAL line (crash mid-append) is repaired crash-safely by
+        truncating the file at the torn line's byte offset; an
+        undecodable INTERIOR line is corruption of committed data and
+        refuses to load (silently dropping everything after it would
+        permanently lose records the checkpoint offset still addresses)."""
+        path = self._log_path(name)
         topic = _Topic()
-        with open(self._log_path(name), "r", encoding="utf-8") as f:
-            for raw in f:
-                if not raw.endswith("\n"):
-                    break  # torn trailing append from a crash: drop it
-                try:
-                    key, value = json.loads(raw)
-                except ValueError:
-                    break
-                topic.log.append(Record(len(topic.log), key, value))
-        # re-write dropped torn tail, then append from there
-        with open(self._log_path(name), "w", encoding="utf-8") as f:
-            for r in topic.log:
-                f.write(json.dumps([r.key, r.value],
-                                   separators=(",", ":")) + "\n")
-        topic.logfile = open(self._log_path(name), "a", encoding="utf-8")
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        torn_at = None
+        while pos < len(data):
+            nl = data.find(b"\n", pos)
+            if nl < 0:
+                torn_at = pos  # unterminated trailing append
+                break
+            try:
+                key, value = json.loads(data[pos:nl].decode("utf-8"))
+            except (ValueError, TypeError, UnicodeDecodeError):
+                # produce() appends each record as ONE newline-terminated
+                # write, and partial writes are prefixes — so any line
+                # that HAS its newline was committed whole; failing to
+                # decode it means committed data corruption, not a crash
+                # artifact, wherever it sits in the file.
+                raise BrokerError(
+                    f"corrupt record in {path} at byte {pos}: refusing "
+                    f"to load (only an unterminated final line is "
+                    f"repairable; committed records are immutable)")
+            topic.log.append(Record(len(topic.log), key, value))
+            pos = nl + 1
+        if torn_at is not None:
+            print(f"broker: dropping torn tail of {path} at byte {torn_at} "
+                  f"({len(data) - torn_at} bytes)", file=sys.stderr)
+            with open(path, "r+b") as f:
+                f.truncate(torn_at)
+        topic.logfile = open(path, "a", encoding="utf-8")
         self._topics[name] = topic
 
     # -- admin ----------------------------------------------------------
@@ -146,3 +168,26 @@ class InProcessBroker:
             if t is None:
                 raise BrokerError(f"unknown topic {topic!r}")
             return len(t.log)
+
+    def sync(self) -> None:
+        """fsync every topic log to stable storage. `produce` only
+        flush()es (process-crash durability); callers that are about to
+        commit an offset DERIVED from these records (MatchService
+        checkpoints) call sync() first so an fsync'd snapshot offset can
+        never address records the OS lost in a power failure. The
+        persist directory is fsync'd too: a freshly created topic log is
+        a new directory entry, and POSIX only makes those durable after
+        a directory fsync."""
+        with self._lock:
+            any_file = False
+            for t in self._topics.values():
+                if t.logfile is not None:
+                    t.logfile.flush()
+                    os.fsync(t.logfile.fileno())
+                    any_file = True
+            if any_file:
+                dfd = os.open(self._persist_dir, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)

@@ -14,13 +14,30 @@ def consume_lines(broker, offset: int = 0, follow: bool = True,
                   poll_timeout: float = 0.5, idle_exit: float = None):
     """Yield `<key> <value>` lines from MatchOut starting at `offset`.
     follow=False stops at the current end; idle_exit stops after that
-    many idle seconds."""
+    many idle seconds. While following, a missing topic is polled for
+    (subscribe-and-wait, like the reference consumer and
+    MatchService.step) instead of crashing a consumer that was started
+    before provisioning."""
     import time
+
+    from kme_tpu.bridge.broker import BrokerError
 
     idle_since = time.monotonic()
     while True:
-        recs = broker.fetch(TOPIC_OUT, offset, 4096,
-                            timeout=poll_timeout if follow else 0.0)
+        try:
+            recs = broker.fetch(TOPIC_OUT, offset, 4096,
+                                timeout=poll_timeout if follow else 0.0)
+        except BrokerError as e:
+            # only a not-yet-provisioned topic is waited for; anything
+            # else (dead broker, protocol error) stays fatal so a
+            # follower doesn't silently busy-loop on a lost broker
+            if not follow or "unknown topic" not in str(e):
+                raise
+            if (idle_exit is not None
+                    and time.monotonic() - idle_since >= idle_exit):
+                return
+            time.sleep(min(poll_timeout, 0.05))
+            continue
         if not recs:
             if not follow:
                 return

@@ -157,6 +157,22 @@ class MatchService:
         """Snapshot engine state + input offset (batch boundary)."""
         from kme_tpu.runtime import checkpoint as ck
 
+        # make the input log durable BEFORE committing an offset into it:
+        # the snapshot is fsync'd, so without this a power loss could
+        # leave an offset addressing MatchIn records the OS never wrote
+        # (resume would silently skip input)
+        sync = getattr(self.broker, "sync", None)
+        if sync is not None:
+            from kme_tpu.bridge.broker import BrokerError
+
+            try:
+                sync()
+            except (BrokerError, OSError) as e:
+                # OSError covers the in-process broker's own fsync
+                # failing (disk full / EIO) — defer, don't die
+                print(f"kme-serve: broker sync failed before checkpoint "
+                      f"({e}); snapshot deferred", file=sys.stderr)
+                return
         if self._session is not None:
             ck.save_session(self.checkpoint_dir, self._session, self.offset)
         elif self._native is not None:

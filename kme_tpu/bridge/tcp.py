@@ -12,6 +12,7 @@ object per line — carrying the three broker operations:
    "timeout_ms":W}                                -> {"ok":true,
                                                      "records":[[o,k,v],...]}
   {"op":"end_offset","topic":T}                   -> {"ok":true,"offset":N}
+  {"op":"sync"}                                   -> {"ok":true}
 
 Errors come back as {"ok":false,"error":"..."}; the client raises
 BrokerError. `serve_broker` hosts an InProcessBroker for any number of
@@ -65,6 +66,9 @@ class _Handler(socketserver.StreamRequestHandler):
                 elif op == "end_offset":
                     resp = {"ok": True,
                             "offset": broker.end_offset(req["topic"])}
+                elif op == "sync":
+                    broker.sync()
+                    resp = {"ok": True}
                 else:
                     resp = {"ok": False, "error": f"unknown op {op!r}"}
             except BrokerError as e:
@@ -97,26 +101,65 @@ def serve_broker(host: str = "127.0.0.1", port: int = 9092,
 
 
 class TcpBroker:
-    """Client with the InProcessBroker API over the line protocol."""
+    """Client with the InProcessBroker API over the line protocol.
+
+    The request/response framing is only sound while requests and
+    replies stay in lockstep, so any socket timeout or partial read
+    poisons the stream (a late reply would be read as the answer to the
+    NEXT request). The client therefore invalidates the connection on
+    any transport fault and transparently reconnects on the next call;
+    blocking fetches extend the socket read deadline by their own
+    server-side wait (`timeout_ms`) so a long poll is never misread as
+    a transport fault."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._rfile = self._sock.makefile("rb")
+        self._addr = (host, port)
+        self._timeout = timeout
         self._lock = threading.Lock()
+        self._sock = None
+        self._rfile = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+        self._rfile = self._sock.makefile("rb")
+
+    def _invalidate(self) -> None:
+        try:
+            self.close()
+        except OSError:
+            pass
+        self._sock = self._rfile = None
 
     def close(self) -> None:
+        if self._sock is None:
+            return
         try:
             self._rfile.close()
         finally:
             self._sock.close()
 
-    def _call(self, req: dict) -> dict:
+    def _call(self, req: dict, extra_wait: float = 0.0) -> dict:
         with self._lock:
-            self._sock.sendall(
-                (json.dumps(req, separators=(",", ":")) + "\n").encode())
-            raw = self._rfile.readline()
-        if not raw:
-            raise BrokerError("broker connection closed")
+            try:
+                if self._sock is None:
+                    self._connect()
+                # read deadline covers the server's own blocking time
+                self._sock.settimeout(self._timeout + extra_wait)
+                self._sock.sendall(
+                    (json.dumps(req, separators=(",", ":")) + "\n").encode())
+                raw = self._rfile.readline()
+            except (socket.timeout, OSError) as e:
+                self._invalidate()
+                raise BrokerError(
+                    f"broker call failed ({e}); connection closed") from e
+            if not raw:
+                self._invalidate()
+                raise BrokerError("broker connection closed")
+            if not raw.endswith(b"\n"):
+                self._invalidate()
+                raise BrokerError("partial broker reply; connection closed")
         resp = json.loads(raw)
         if not resp.get("ok"):
             raise BrokerError(resp.get("error", "unknown broker error"))
@@ -142,11 +185,16 @@ class TcpBroker:
     def fetch(self, topic: str, offset: int, max_records: int = 1024,
               timeout: float = 0.0) -> List[Record]:
         resp = self._call({"op": "fetch", "topic": topic, "offset": offset,
-                           "max": max_records, "timeout_ms": timeout * 1e3})
+                           "max": max_records, "timeout_ms": timeout * 1e3},
+                          extra_wait=timeout)
         return [Record(o, k, v) for o, k, v in resp["records"]]
 
     def end_offset(self, topic: str) -> int:
         return self._call({"op": "end_offset", "topic": topic})["offset"]
+
+    def sync(self) -> None:
+        """fsync the broker's topic logs (see InProcessBroker.sync)."""
+        self._call({"op": "sync"})
 
 
 def parse_addr(addr: str) -> tuple:

@@ -141,7 +141,7 @@ def make_lane_state(cfg: LaneConfig):
         # ~100us/step in reshape copies + un-aliased scatters); flat
         # arrays scatter with far less traffic, though XLA:TPU scatter
         # still rewrites the array (~1us/MB — the dominant per-step HBM
-        # term, see the bench's est_hbm_gbps model). A per-lane (S, P)
+        # term, see the bench's modeled_hbm_gbps model). A per-lane (S, P)
         # associative table was evaluated and rejected: hot-symbol
         # holder counts approach A on skewed workloads, so P cannot
         # shrink below O(A) without spuriously capacity-rejecting them.

@@ -256,18 +256,19 @@ class LaneSession:
         rejects = {r.msg_index for r in sched.host_rejects}
         barriers = {b.msg_index for b in sched.barriers}
 
+        from kme_tpu.wire import order_json
+
         out: List[List[str]] = []
         for i, m in enumerate(msgs):
-            nxt = "null" if m.next is None else str(m.next)
-            prv = "null" if m.prev is None else str(m.prev)
-            mid = (f'"oid":{m.oid},"aid":{m.aid},"sid":{m.sid},'
-                   f'"price":{m.price},"size":{m.size},"next":{nxt}')
-            lines = [f'IN {{"action":{m.action},{mid},"prev":{prv}}}']
+            in_body = order_json(m.action, m.oid, m.aid, m.sid, m.price,
+                                 m.size, m.next, m.prev)
+            lines = [f'IN {in_body}']
             if i in rejects or (i in barriers and not barrier_ok[i]):
-                lines.append(
-                    f'OUT {{"action":{op.REJECT},{mid},"prev":{prv}}}')
+                lines.append('OUT ' + order_json(
+                    op.REJECT, m.oid, m.aid, m.sid, m.price, m.size,
+                    m.next, m.prev))
             elif i in barriers:
-                lines.append(f'OUT {{"action":{m.action},{mid},"prev":{prv}}}')
+                lines.append(f'OUT {in_body}')
             else:
                 lane_act = act_of[i]
                 ok = ok_of[i]
@@ -283,24 +284,19 @@ class LaneSession:
                         maid = idx_to_aid[f_aid[o0 + e]]
                         mprice = f_price[o0 + e]
                         fsz = f_size[o0 + e]
-                        lines.append(
-                            f'OUT {{"action":{mk_act},"oid":{moid},'
-                            f'"aid":{maid},"sid":{sid},"price":0,'
-                            f'"size":{fsz},"next":null,"prev":null}}')
-                        lines.append(
-                            f'OUT {{"action":{tk_act},"oid":{m.oid},'
-                            f'"aid":{m.aid},"sid":{sid},'
-                            f'"price":{m.price - mprice},"size":{fsz},'
-                            f'"next":null,"prev":null}}')
-                    esz = resid_of[i]
-                    eprv = str(prev_of[i]) if append_of[i] else prv
-                    lines.append(
-                        f'OUT {{"action":{m.action},"oid":{m.oid},'
-                        f'"aid":{m.aid},"sid":{m.sid},"price":{m.price},'
-                        f'"size":{esz},"next":{nxt},"prev":{eprv}}}')
+                        lines.append('OUT ' + order_json(
+                            mk_act, moid, maid, sid, 0, fsz))
+                        lines.append('OUT ' + order_json(
+                            tk_act, m.oid, m.aid, sid, m.price - mprice,
+                            fsz))
+                    lines.append('OUT ' + order_json(
+                        m.action, m.oid, m.aid, m.sid, m.price,
+                        resid_of[i], m.next,
+                        prev_of[i] if append_of[i] else m.prev))
                 else:
-                    act = m.action if ok else op.REJECT
-                    lines.append(f'OUT {{"action":{act},{mid},"prev":{prv}}}')
+                    lines.append('OUT ' + order_json(
+                        m.action if ok else op.REJECT, m.oid, m.aid,
+                        m.sid, m.price, m.size, m.next, m.prev))
             out.append(lines)
         return out
 

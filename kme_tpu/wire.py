@@ -78,15 +78,29 @@ def _as_int(field: str, v) -> int:
     return v
 
 
+def order_json(action: int, oid, aid, sid, price, size,
+               next: Optional[int] = None,
+               prev: Optional[int] = None) -> str:
+    """THE Jackson wire template (compact, declaration field order,
+    next/prev always present — KProcessor.java:488). Every serializer in
+    the tree — dumps_order on OrderMsg objects and the session's bulk
+    scalar reconstruction (runtime/session.py) — goes through this one
+    function, so a format change cannot fork the serving path from the
+    record path (the hazard is also pinned by tests/test_lanes_engine's
+    process/process_wire equivalence check)."""
+    nxt = "null" if next is None else str(next)
+    prv = "null" if prev is None else str(prev)
+    return (
+        f'{{"action":{action},"oid":{oid},"aid":{aid},"sid":{sid},'
+        f'"price":{price},"size":{size},"next":{nxt},"prev":{prv}}}'
+    )
+
+
 def dumps_order(o: OrderMsg) -> str:
     """Serialize exactly like Jackson on the reference POJO: compact,
     declaration field order, next/prev always present (KProcessor.java:488)."""
-    nxt = "null" if o.next is None else str(o.next)
-    prv = "null" if o.prev is None else str(o.prev)
-    return (
-        f'{{"action":{o.action},"oid":{o.oid},"aid":{o.aid},"sid":{o.sid},'
-        f'"price":{o.price},"size":{o.size},"next":{nxt},"prev":{prv}}}'
-    )
+    return order_json(o.action, o.oid, o.aid, o.sid, o.price, o.size,
+                      o.next, o.prev)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -226,8 +226,75 @@ def test_broker_log_persistence_and_torn_tail(tmp_path):
 
     with open(tmp_path / f"{TOPIC_IN}.log", "a", encoding="utf-8") as f:
         f.write('["k", "torn')  # no newline: crash mid-append
+    with open(tmp_path / f"{TOPIC_IN}.log", "rb") as f:
+        pre_torn = f.read()
     b3 = InProcessBroker(persist_dir=d)
     assert b3.end_offset(TOPIC_IN) == 3  # torn tail dropped
+    # the repair is a TRUNCATE at the torn byte offset — committed
+    # records are never rewritten (crash during a full rewrite would
+    # lose them)
+    with open(tmp_path / f"{TOPIC_IN}.log", "rb") as f:
+        assert f.read() == pre_torn[:pre_torn.rfind(b"\n") + 1]
+
+
+def test_broker_log_corruption_refuses_load(tmp_path):
+    """Any undecodable newline-TERMINATED line — interior or final — is
+    corruption of committed data (produce appends one whole line per
+    record; partial writes are prefixes, so a torn append can never have
+    its newline): the broker refuses to load rather than silently
+    truncating committed records a checkpoint offset may still address."""
+    import pytest
+
+    from kme_tpu.bridge.broker import BrokerError
+
+    d = str(tmp_path)
+    b1 = InProcessBroker(persist_dir=d)
+    provision(b1)
+    for i in range(3):
+        b1.produce(TOPIC_IN, None, f'{{"action":100,"aid":{i}}}')
+    path = tmp_path / f"{TOPIC_IN}.log"
+    pristine = path.read_bytes()
+    lines = pristine.splitlines(keepends=True)
+    path.write_bytes(b"".join([lines[0], b'NOT JSON\n'] + lines[2:]))
+    with pytest.raises(BrokerError, match="corrupt record"):
+        InProcessBroker(persist_dir=d)
+    # newline-terminated garbage FINAL line: still committed-data
+    # corruption, not a repairable torn tail
+    path.write_bytes(b"".join(lines[:2] + [b'NOT JSON\n']))
+    with pytest.raises(BrokerError, match="corrupt record"):
+        InProcessBroker(persist_dir=d)
+
+
+def test_broker_sync_and_consume_waits_for_topic(tmp_path):
+    """broker.sync() fsyncs the topic logs (checkpoint calls it before
+    committing an offset); consume_lines with follow=True polls for a
+    not-yet-provisioned MatchOut instead of crashing."""
+    from kme_tpu.bridge.consume import consume_lines
+
+    d = str(tmp_path)
+    b = InProcessBroker(persist_dir=d)
+    provision(b)
+    b.produce(TOPIC_IN, None, '{"action":100,"aid":1}')
+    b.sync()  # must not raise; records durable
+    assert InProcessBroker(persist_dir=d).end_offset(TOPIC_IN) == 1
+
+    b2 = InProcessBroker()  # nothing provisioned: MatchOut missing
+    # follow=False propagates (fail fast for one-shot reads)
+    import pytest
+
+    from kme_tpu.bridge.broker import BrokerError
+
+    with pytest.raises(BrokerError):
+        list(consume_lines(b2, follow=False))
+    # follow=True + idle_exit polls, then exits cleanly when the topic
+    # never appears
+    assert list(consume_lines(b2, follow=True, poll_timeout=0.02,
+                              idle_exit=0.1)) == []
+    # and picks records up once the topic exists
+    provision(b2)
+    b2.produce("MatchOut", "OUT", "x")
+    assert list(consume_lines(b2, follow=True, poll_timeout=0.02,
+                              idle_exit=0.2)) == ["OUT x"]
 
 
 def test_service_crash_resume_full_process_restart(tmp_path):
