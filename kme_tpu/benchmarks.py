@@ -200,9 +200,15 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
 
 def _est_step_bytes(S, A, N, E, W) -> int:
     """Modeled HBM bytes touched per scan step (see bench detail note):
-    pos_amt/pos_avail scatter copies (read+write, 8B each), 6 slot-row
-    arrays gathered + scattered at width W, fill outputs."""
-    pos = 2 * 2 * 8 * S * A
+    position traffic, 6 slot-row arrays gathered + scattered at width W,
+    fill outputs. With pos_dma active (compact width and accounts % 64
+    == 0 — mirrors LaneSession's enable rule) positions move as row DMAs
+    (W rows x 2A i32, in+out, two arrays) instead of full-array scatter
+    rewrites."""
+    if W < S and (2 * A) % 128 == 0:  # pos_dma row DMA
+        pos = 2 * 2 * W * 2 * A * 4
+    else:  # full-array scatter rewrite, read+write, 8B each
+        pos = 2 * 2 * 8 * S * A
     rows = 2 * 6 * W * 2 * N * 4
     fills = 4 * W * E * 8
     return pos + rows + fills

@@ -122,10 +122,39 @@ class LaneConfig:
     # scan-body unroll factor: amortizes XLA loop overhead and lets the
     # compiler fuse across adjacent steps; shapes are unchanged
     unroll: int = 1
+    # pos_dma (compact mode only): positions live as PLANAR lo/hi int32
+    # rows (S, 2A/128, 128) updated IN PLACE by Pallas row-DMA kernels
+    # (ops/rowdma.py) instead of flat (S*A,) int64 arrays rewritten
+    # whole by XLA scatter (~24us/step at the bench shapes vs ~2.7us
+    # for the DMA round trip — measured, scripts/exp_pallas_rowdma.py).
+    # Requires accounts % 64 == 0 (128-lane row tiles). LaneSession
+    # enables it automatically; snapshots stay canonical (flat s64).
+    pos_dma: bool = False
+
+
+def _fill_slack(cfg: LaneConfig) -> int:
+    """Slack columns past the fill-log overflow watermark (see the
+    fillbuf note in make_lane_state). Compact mode's block append can
+    write up to one full (M*E,) window block starting at the watermark;
+    M is bucketed to a power of two over at most window*width slots."""
+    if cfg.width <= 0:
+        return 1
+    from kme_tpu.utils import pow2_bucket
+
+    return pow2_bucket(cfg.window * cfg.width) * cfg.max_fills
 
 
 def make_lane_state(cfg: LaneConfig):
     S, N, A = cfg.lanes, cfg.slots, cfg.accounts
+    if cfg.pos_dma:
+        from kme_tpu.ops import rowdma
+
+        sub, ln = rowdma.row_shape(2 * A)
+        pos = {"pos_amt": jnp.zeros((S, sub, ln), _I32),
+               "pos_avail": jnp.zeros((S, sub, ln), _I32)}
+    else:
+        pos = {"pos_amt": jnp.zeros((S * A,), _I64),
+               "pos_avail": jnp.zeros((S * A,), _I64)}
     return {
         "slot_oid": jnp.zeros((S, 2, N), _I64),
         "slot_aid": jnp.zeros((S, 2, N), _I32),
@@ -135,7 +164,8 @@ def make_lane_state(cfg: LaneConfig):
         "slot_used": jnp.zeros((S, 2, N), bool),
         "seq": jnp.zeros((S,), _I32),
         "book_exists": jnp.zeros((S,), bool),
-        # positions are kept FLAT (S*A,) — lane-major, index lane*A+acc.
+        # positions (non-pos_dma): kept FLAT (S*A,) — lane-major, index
+        # lane*A+acc.
         # A 2-D (S, A) layout costs a physical re-tiling copy per scan
         # step on TPU for the reshape to flat scatter indices (profiled:
         # ~100us/step in reshape copies + un-aliased scatters); flat
@@ -148,17 +178,24 @@ def make_lane_state(cfg: LaneConfig):
         # There is no `used` flag: in fixed mode a position exists iff
         # amt != 0 (delete-at-zero, KProcessor.java:281-284 corrected),
         # and the engine maintains avail == 0 whenever amt == 0.
-        "pos_amt": jnp.zeros((S * A,), _I64),
-        "pos_avail": jnp.zeros((S * A,), _I64),
+        **pos,
         "bal": jnp.zeros((A,), _I64),
         "bal_used": jnp.zeros((A,), bool),
         "err": jnp.zeros((), _I32),
-        "metrics": jnp.zeros((N_METRICS,), _I64),
-        # persistent fill log: rows oid/aid/price/size, one slot of slack
-        # for clamped overflow writes; filloff = next free position. Only
-        # the used prefix ever crosses to the host (ONE sliced fetch per
-        # batch — the tunneled-TPU I/O design, see chunk_compaction).
-        "fillbuf": jnp.zeros((4, cfg.fill_buffer + 1), _I64),
+        # compact mode keeps the counters as a TUPLE of scalars: the
+        # (12,) array form costs a serialized 12-way concatenate per
+        # scan step (~8us/step profiled, x64 pairs); scalar carries are
+        # free. Snapshots canonicalize to the (12,) array either way.
+        "metrics": (tuple(jnp.zeros((), _I64) for _ in range(N_METRICS))
+                    if cfg.width > 0 else jnp.zeros((N_METRICS,), _I64)),
+        # persistent fill log: rows oid/aid/price/size; filloff = next
+        # free position. Only the used prefix ever crosses to the host
+        # (ONE sliced fetch per batch — the tunneled-TPU I/O design, see
+        # chunk_compaction). Compact mode appends whole sorted (M*E,)
+        # blocks with one dynamic_update_slice, so the log carries a
+        # full block of slack past the overflow watermark; the
+        # full-width path's per-entry scatter needs one clamp slot.
+        "fillbuf": jnp.zeros((4, cfg.fill_buffer + _fill_slack(cfg)), _I64),
         "filloff": jnp.zeros((1,), _I64),
     }
 
@@ -192,6 +229,10 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
     X = cfg.width if compact else S
     assert not (compact and axis_name), \
         "active-lane compaction is single-device only"
+    assert not (cfg.pos_dma and not compact), \
+        "pos_dma requires active-lane compaction"
+    if cfg.pos_dma:
+        from kme_tpu.ops import rowdma
 
     # TPU-friendly indexed access: multi-dim advanced indexing like
     # a[lane_ids, side, idx] lowers to a generic (slow, ~ms) gather /
@@ -223,19 +264,54 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
             seq_v = st["seq"]
             be_v = st["book_exists"]
 
-        # positions via flat lane*A+acc indices — the state arrays are
-        # already flat (make_lane_state), so the scatters alias in place
-        pbase = lanes * A                           # (X,) int32; S*A < 2^31
-        pa_f = st["pos_amt"]
-        pv_f = st["pos_avail"]
+        if cfg.pos_dma:
+            # row-DMA the W active lanes' position rows into small
+            # (X, A) s64 blocks; every read/write below is block-local
+            # (each step slot owns its lane row — scheduler invariant),
+            # and the updated rows DMA back IN PLACE at the end of the
+            # step. The 16MB flat arrays are never scattered.
+            pa_f = rowdma.join_rows(
+                rowdma.gather_lane_rows(st["pos_amt"], lanes))
+            pv_f = rowdma.join_rows(
+                rowdma.gather_lane_rows(st["pos_avail"], lanes))
 
-        def pos_read(arr_f, accs):                  # accs: (X,) | (X, K)
-            idx = pbase[:, None] + accs if accs.ndim == 2 else pbase + accs
-            return arr_f[idx]
+            def pos_read(blk, accs):                # accs: (X,) | (X, K)
+                i = (accs if accs.ndim == 2 else accs[:, None]).astype(_I32)
+                v = jnp.take_along_axis(blk, i, axis=1)
+                return v if accs.ndim == 2 else v[:, 0]
 
-        def pos_write(arr_f, accs, vals):
-            idx = pbase[:, None] + accs if accs.ndim == 2 else pbase + accs
-            return arr_f.at[idx].set(vals.astype(arr_f.dtype))
+            acc_iota = jnp.arange(A, dtype=_I32)
+
+            def pos_write(blk, accs, vals):
+                # one-hot masked merge, NOT scatter: XLA:TPU serializes
+                # scatter updates (~11us for a (W,2E)->(W,A) put_along,
+                # profiled), while the (X, K, A) one-hot reduction is
+                # pure vectorized VPU work. Duplicate accounts within a
+                # row carry IDENTICAL values by construction (the engine
+                # computes each account's final value for every entry),
+                # so a max-select over contributors is exact.
+                i = (accs if accs.ndim == 2 else accs[:, None]).astype(_I32)
+                v = (vals if vals.ndim == 2 else vals[:, None]).astype(blk.dtype)
+                oh = i[:, :, None] == acc_iota                  # (X, K, A)
+                hit = jnp.any(oh, axis=1)                       # (X, A)
+                BOT = jnp.asarray(-(1 << 62), blk.dtype)
+                merged = jnp.max(jnp.where(oh, v[:, :, None], BOT), axis=1)
+                return jnp.where(hit, merged, blk)
+        else:
+            # positions via flat lane*A+acc indices — the state arrays
+            # are flat (make_lane_state); XLA scatter rewrites the whole
+            # array per step (the pos_dma path avoids this)
+            pbase = lanes * A                       # (X,) int32; S*A < 2^31
+            pa_f = st["pos_amt"]
+            pv_f = st["pos_avail"]
+
+            def pos_read(arr_f, accs):              # accs: (X,) | (X, K)
+                idx = pbase[:, None] + accs if accs.ndim == 2 else pbase + accs
+                return arr_f[idx]
+
+            def pos_write(arr_f, accs, vals):
+                idx = pbase[:, None] + accs if accs.ndim == 2 else pbase + accs
+                return arr_f.at[idx].set(vals.astype(arr_f.dtype))
 
         is_trade = (act == L_BUY) | (act == L_SELL)
         is_buy = act == L_BUY
@@ -380,16 +456,19 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
         # XLA:TPU's unimplemented X64-rewrite path and fails to compile.)
         twoE = 2 * E
         idx2 = jnp.arange(twoE, dtype=_I32)
-        acc = jnp.zeros((X, twoE), _I32)
-        acc = acc.at[:, 0::2].set(fo_aid).at[:, 1::2].set(
-            jnp.broadcast_to(aid[:, None], (X, E)))
+        # interleave maker/taker entries [m0, t0, m1, t1, ...] via
+        # stack+reshape — a pure relayout; the earlier strided
+        # .at[:, 0::2].set form lowered to serialized scatters
+        # (~1.4us each, profiled)
+        def interleave(m, t):
+            return jnp.stack([m, t], axis=-1).reshape(X, twoE)
+
+        acc = interleave(fo_aid, jnp.broadcast_to(aid[:, None], (X, E)))
         m_sgn = jnp.where(is_buy[:, None], -fo_fill, fo_fill).astype(_I64)
         t_sgn = jnp.where(is_buy[:, None], fo_fill, -fo_fill).astype(_I64)
-        sgn = jnp.zeros((X, twoE), _I64).at[:, 0::2].set(m_sgn)
-        sgn = sgn.at[:, 1::2].set(t_sgn)
+        sgn = interleave(m_sgn, t_sgn)
         fv = (fo_fill > 0) & trade_acc[:, None]
-        fvalid = jnp.zeros((X, twoE), bool).at[:, 0::2].set(fv)
-        fvalid = fvalid.at[:, 1::2].set(fv)
+        fvalid = interleave(fv, fv)
         a0 = pos_read(pa_f, acc)   # 0 when no position exists
         v0 = pos_read(pv_f, acc)
         # eq[s, i, j]: entry i is a VALID contributor to entry j's account.
@@ -509,7 +588,7 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
 
         # ------------------------------------------------ metrics delta
         cnt = lambda m: jnp.sum(m.astype(_I64))
-        met = jnp.stack([
+        met = (
             cnt(act != L_NOP),                                 # MSGS
             cnt(trade_acc),                                    # TRADES_OK
             jnp.sum(jnp.where(trade_acc, nfill, 0).astype(_I64)),
@@ -524,10 +603,15 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
                 | ((act == L_TRANSFER) & ~transfer_ok)
                 | ((act == L_ADD_SYMBOL) & ~addsym_ok)),       # REJ_OTHER
             jnp.zeros((), _I64),                               # BARRIERS
-        ])
-        if axis_name is not None:
-            met = jax.lax.psum(met, axis_name)
-        metrics = st["metrics"] + met
+        )
+        if compact:
+            # scalar-tuple carry: no per-step (12,) concatenate
+            metrics = tuple(m + d for m, d in zip(st["metrics"], met))
+        else:
+            met = jnp.stack(met)
+            if axis_name is not None:
+                met = jax.lax.psum(met, axis_name)
+            metrics = st["metrics"] + met
 
         ok = jnp.where(
             is_trade, trade_acc,
@@ -552,8 +636,16 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
                 new_st[k] = st[k].at[lanes].set(v)
             new_st["seq"] = st["seq"].at[lanes].set(seq)
             new_st["book_exists"] = st["book_exists"].at[lanes].set(book_exists)
-            new_st["pos_amt"] = pa_f
-            new_st["pos_avail"] = pv_f
+            if cfg.pos_dma:
+                # DMA the updated (X, A) blocks back in place (the
+                # kernel itself skips scrap-lane rows)
+                new_st["pos_amt"] = rowdma.scatter_lane_rows(
+                    st["pos_amt"], lanes, rowdma.split_rows(pa_f), S - 1)
+                new_st["pos_avail"] = rowdma.scatter_lane_rows(
+                    st["pos_avail"], lanes, rowdma.split_rows(pv_f), S - 1)
+            else:
+                new_st["pos_amt"] = pa_f
+                new_st["pos_avail"] = pv_f
             new_st.update(bal=bal, bal_used=bal_used, err=err,
                           metrics=metrics)
         else:
@@ -622,6 +714,10 @@ def chunk_compaction(cfg: LaneConfig, T: int, M: int, step):
     FB = cfg.fill_buffer
     compact = cfg.width > 0
     X = cfg.width if compact else S
+    assert not compact or M * E <= _fill_slack(cfg), (
+        f"chunk M={M} x max_fills={E} exceeds the fill-log slack "
+        f"{_fill_slack(cfg)} — the block append could clamp backward and "
+        f"corrupt earlier fills without tripping the sticky error")
 
     def chunk(state, cb):
         valid = cb["t"] < T
@@ -652,35 +748,59 @@ def chunk_compaction(cfg: LaneConfig, T: int, M: int, step):
         fp, fs = pick(outs["fill_price"]), pick(outs["fill_size"])
 
         state = dict(state)
-        couts = {
-            "ok": jnp.where(valid, pick(outs["ok"]), False),
-            "residual": pick(outs["residual"]),
-            "append": jnp.where(valid, pick(outs["append"]), False),
-            "prev_oid": pick(outs["prev_oid"]),
-            "cap_reject": jnp.where(valid, pick(outs["cap_reject"]), False),
-            "nfill": nfill,
-            "nfill_total": total,
-        }
         # append to the persistent fill log at the running offset
         base = state["filloff"][0]
         offs = base + (jnp.cumsum(nfill) - nfill).astype(_I64)
         eidx = jnp.arange(E, dtype=_I64)[None, :]
         mask = eidx < nfill[:, None].astype(_I64)
-        pos = jnp.where(mask, jnp.minimum(offs[:, None] + eidx, FB), FB)
-        pos = pos.astype(_I32).reshape(-1)
-        buf = state["fillbuf"]
-        for c, arr in enumerate((fo, fa, fp, fs)):
-            buf = buf.at[c].set(
-                buf[c].at[pos].set(arr.astype(_I64).reshape(-1)))
         new_off = base + total.astype(_I64)
+        if compact:
+            # Stream-compact the (M, E) fill grid with ONE multi-operand
+            # sort — valid entries keyed by their window-relative log
+            # position (already unique and in (t, lane, e) order),
+            # padding keyed past the end — then append the packed block
+            # with a single in-place dynamic_update_slice. The previous
+            # per-entry scatter serialized on TPU (~4.7ms per window at
+            # M=4096, profiled); the sort + contiguous DUS is ~2 orders
+            # cheaper. DUS clamps the start when the log overflows; the
+            # sticky error below fires before the host ever reads fills.
+            rel = offs[:, None] - base + eidx              # (M, E)
+            key = jnp.where(mask, rel, M * E).astype(_I32).reshape(-1)
+            _, so, sa, sp, ss = jax.lax.sort(
+                (key, fo.astype(_I64).reshape(-1),
+                 fa.astype(_I64).reshape(-1), fp.astype(_I64).reshape(-1),
+                 fs.astype(_I64).reshape(-1)), num_keys=1)
+            blk = jnp.stack([so, sa, sp, ss])              # (4, M*E)
+            buf = jax.lax.dynamic_update_slice(
+                state["fillbuf"], blk, (jnp.zeros((), _I64), base))
+        else:
+            pos = jnp.where(mask, jnp.minimum(offs[:, None] + eidx, FB), FB)
+            pos = pos.astype(_I32).reshape(-1)
+            buf = state["fillbuf"]
+            for c, arr in enumerate((fo, fa, fp, fs)):
+                buf = buf.at[c].set(
+                    buf[c].at[pos].set(arr.astype(_I64).reshape(-1)))
         err = state["err"]
         err = jnp.where((err == LERR_OK) & (new_off > FB),
                         jnp.asarray(LERR_FILLBUF_FULL, _I32), err)
         state["fillbuf"] = buf
         state["filloff"] = jnp.full((1,), 0, _I64) + new_off
         state["err"] = err
-        couts["err"] = state["err"]
-        return state, couts
+        # ALL per-message outputs ride ONE (8, M) i64 array — a single
+        # device->host transfer per window (each separate np.asarray
+        # costs a tunnel round trip, ~8ms profiled). Rows 6/7 broadcast
+        # the err/total scalars.
+        packed = jnp.stack([
+            jnp.where(valid, pick(outs["ok"]), False).astype(_I64),
+            pick(outs["residual"]).astype(_I64),
+            jnp.where(valid, pick(outs["append"]), False).astype(_I64),
+            pick(outs["prev_oid"]),
+            jnp.where(valid, pick(outs["cap_reject"]), False).astype(_I64),
+            nfill.astype(_I64),
+            jnp.full((M,), 0, _I64) + err.astype(_I64),
+            jnp.full((M,), 0, _I64) + total.astype(_I64),
+        ])
+        return state, {"packed": packed}
 
     return chunk
 
@@ -701,11 +821,17 @@ def build_gauges(cfg: LaneConfig):
     def gauges(state):
         used = state["slot_used"]
         depth = jnp.sum(used.astype(_I32), axis=2)     # (S, 2)
+        pa = state["pos_amt"]
+        if cfg.pos_dma:  # planar lo/hi rows: live iff either half != 0
+            v = pa.reshape(pa.shape[0], 2, -1)
+            live = (v[:, 0] != 0) | (v[:, 1] != 0)
+        else:
+            live = pa != 0
         return {
             "open_orders": jnp.sum(used.astype(_I64)),
             "books": jnp.sum(state["book_exists"].astype(_I64)),
             "accounts": jnp.sum(state["bal_used"].astype(_I64)),
-            "positions": jnp.sum((state["pos_amt"] != 0).astype(_I64)),
+            "positions": jnp.sum(live.astype(_I64)),
             "max_book_depth": jnp.max(depth).astype(_I64),
         }
 
@@ -740,6 +866,27 @@ def build_barrier_ops(cfg: LaneConfig, axis_name: Optional[str] = None):
     `_payout` fixed mode."""
     S, N, A = cfg.lanes, cfg.slots, cfg.accounts
     lane_ids = jnp.arange(S, dtype=_I32)
+
+    def _pos_row(st, key, lane):
+        """One lane's positions as an (A,) s64 row, either layout."""
+        if cfg.pos_dma:
+            from kme_tpu.ops import rowdma
+
+            r = jax.lax.dynamic_index_in_dim(
+                st[key], lane, 0, keepdims=False).reshape(2 * A)
+            return rowdma.join64(r[:A], r[A:])
+        return jax.lax.dynamic_slice_in_dim(st[key], lane * A, A)
+
+    def _pos_row_set(st_arr, lane, row64):
+        """Write an (A,) s64 row back at `lane`, either layout."""
+        if cfg.pos_dma:
+            from kme_tpu.ops import rowdma
+
+            lo, hi = rowdma.split64(row64)
+            packed = jnp.concatenate([lo, hi]).reshape(st_arr.shape[1:])
+            return st_arr.at[lane].set(packed)
+        return jax.lax.dynamic_update_slice_in_dim(
+            st_arr, row64.astype(st_arr.dtype), lane * A, 0)
 
     def wipe_lane(st, lane, do):
         """Release margin for every resting order of `lane`, clear slots.
@@ -783,9 +930,8 @@ def build_barrier_ops(cfg: LaneConfig, axis_name: Optional[str] = None):
         # zero delta derived from lane-sharded state so its varying-axis
         # type matches the loop body's output under shard_map
         zv64 = (st["seq"][0] * 0).astype(_I64)
-        pbase = lane * A  # positions are flat (S*A,) lane-major
-        carry = (jax.lax.dynamic_slice_in_dim(st["pos_amt"], pbase, A),
-                 jax.lax.dynamic_slice_in_dim(st["pos_avail"], pbase, A),
+        carry = (_pos_row(st, "pos_amt", lane),
+                 _pos_row(st, "pos_avail", lane),
                  jnp.zeros((A,), _I64) + zv64)
         pos_amt_l, pos_avail_l, bal_delta = jax.lax.fori_loop(
             0, 2 * N, body, carry)
@@ -801,13 +947,11 @@ def build_barrier_ops(cfg: LaneConfig, axis_name: Optional[str] = None):
         lane_c = jnp.maximum(lane, 0)
         pos_amt_l, pos_avail_l, bal_delta = wipe_lane(state, lane_c, do)
         st = dict(state)
-        pbase = lane_c * A
 
         def upd_pos(key, new_row):
-            cur = jax.lax.dynamic_slice_in_dim(st[key], pbase, A)
-            return jax.lax.dynamic_update_slice_in_dim(
-                st[key], jnp.where(do, new_row, cur).astype(st[key].dtype),
-                pbase, 0)
+            cur = _pos_row(st, key, lane_c)
+            return _pos_row_set(st[key], lane_c,
+                                jnp.where(do, new_row, cur))
 
         st["pos_amt"] = upd_pos("pos_amt", pos_amt_l)
         st["pos_avail"] = upd_pos("pos_avail", pos_avail_l)
@@ -821,16 +965,14 @@ def build_barrier_ops(cfg: LaneConfig, axis_name: Optional[str] = None):
         is_payout = mode > 0
         credit = (mode == 1)
         pm = jnp.where(do & is_payout, True, False)
-        amts = jax.lax.dynamic_slice_in_dim(st["pos_amt"], pbase, A)
+        amts = _pos_row(st, "pos_amt", lane_c)
         pay = jnp.where(pm & credit,
                         amts * credit_size.astype(_I64), 0)
         bal_delta = bal_delta + pay
 
         def clear_pos(key):
-            cur = jax.lax.dynamic_slice_in_dim(st[key], pbase, A)
-            return jax.lax.dynamic_update_slice_in_dim(
-                st[key], jnp.where(pm, 0, cur).astype(st[key].dtype),
-                pbase, 0)
+            cur = _pos_row(st, key, lane_c)
+            return _pos_row_set(st[key], lane_c, jnp.where(pm, 0, cur))
 
         st["pos_amt"] = clear_pos("pos_amt")
         st["pos_avail"] = clear_pos("pos_avail")
@@ -841,8 +983,13 @@ def build_barrier_ops(cfg: LaneConfig, axis_name: Optional[str] = None):
         else:
             do_any = do
         st["bal"] = st["bal"] + bal_delta
-        st["metrics"] = st["metrics"].at[MET_BARRIERS].add(
-            do_any.astype(_I64))
+        if cfg.width > 0:  # scalar-tuple metrics carry (compact mode)
+            mets = list(st["metrics"])
+            mets[MET_BARRIERS] = mets[MET_BARRIERS] + do_any.astype(_I64)
+            st["metrics"] = tuple(mets)
+        else:
+            st["metrics"] = st["metrics"].at[MET_BARRIERS].add(
+                do_any.astype(_I64))
         return st, do_any
 
     return settle

@@ -95,6 +95,10 @@ def save_session(ckpt_dir: str, session, offset: int) -> str:
         if k in _LANE_KEYS:
             v = v[:S]
         elif k in _POS_KEYS:
+            if v.ndim == 3:  # pos_dma planar i32 rows -> canonical s64
+                from kme_tpu.ops.rowdma import unpack64_np
+
+                v = unpack64_np(v, v.shape[0]).reshape(-1)
             v = v[:S * A]
         payload[k] = v
     payload["meta"] = np.frombuffer(
@@ -182,12 +186,42 @@ def _restore_one(path: str, shards: Optional[int], width: Optional[int]):
         if k in _SKIP_KEYS:
             state[k] = v  # recreated empty (drained at snapshot)
             continue
-        if k == "metrics" and k not in data.files:
-            state[k] = v  # pure observability counter: pre-metrics
-            continue      # snapshots restore with fresh zeros
+        if k == "metrics":
+            if k not in data.files:
+                state[k] = v  # pure observability counter: pre-metrics
+                continue      # snapshots restore with fresh zeros
+            arr = np.asarray(data[k])
+            want = (len(v),) if isinstance(v, tuple) else tuple(v.shape)
+            if arr.shape != want:
+                raise ValueError(
+                    f"snapshot {path}: shape mismatch for metrics: "
+                    f"{arr.shape} vs {want}")
+            # compact device state carries the counters as a scalar
+            # tuple; the canonical form is the (12,) array
+            state[k] = (tuple(jnp.asarray(x) for x in arr)
+                        if isinstance(v, tuple) else jnp.asarray(arr))
+            continue
         arr = np.asarray(data[k])
-        if k in _LANE_KEYS or k in _POS_KEYS:
-            n = S if k in _LANE_KEYS else S * A
+        if k in _POS_KEYS:
+            # canonical form is ALWAYS flat (S*A,) s64; the device
+            # layout may be pos_dma planar i32 rows
+            if arr.shape != (S * A,):
+                raise ValueError(
+                    f"snapshot {path}: shape mismatch for {k}: "
+                    f"{arr.shape} vs canonical ({S * A},)")
+            if v.ndim == 3:  # pack into planar rows, scrap row zero
+                from kme_tpu.ops.rowdma import pack64_np
+
+                S_dev = v.shape[0]
+                full64 = np.zeros((S_dev, A), np.int64)
+                full64[:S] = arr.reshape(S, A)
+                state[k] = jnp.asarray(pack64_np(full64, S_dev))
+            else:
+                full = np.array(v)
+                full[:S * A] = arr
+                state[k] = jnp.asarray(full)
+        elif k in _LANE_KEYS:
+            n = S
             if arr.shape[:1] != (n,) or arr.shape[1:] != v.shape[1:]:
                 raise ValueError(
                     f"snapshot {path}: shape mismatch for {k}: "

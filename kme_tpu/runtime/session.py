@@ -82,11 +82,16 @@ class LaneSession:
         W = min(W, cfg.lanes)
         if shards > 1 or W < 0:
             W = 0
-        self.cfg = cfg = dataclasses.replace(cfg, width=0)
+        self.cfg = cfg = dataclasses.replace(cfg, width=0, pos_dma=False)
         # device config: compaction reserves the last lane as the padding
-        # scrap row, so the device state carries one extra lane
+        # scrap row, so the device state carries one extra lane. The
+        # compact path keeps positions as planar i32 rows updated in
+        # place by Pallas row-DMA (engine/lanes.py pos_dma) whenever the
+        # row width tiles cleanly (accounts % 64 == 0).
+        use_dma = W > 0 and (2 * cfg.accounts) % 128 == 0
         self.dev_cfg = (dataclasses.replace(cfg, lanes=cfg.lanes + 1,
-                                            width=W) if W else cfg)
+                                            width=W, pos_dma=use_dma)
+                        if W else cfg)
         self.shards = shards
         if shards > 1:
             from kme_tpu.parallel import mesh as M
@@ -184,10 +189,22 @@ class LaneSession:
             async_prefetch(run.outs.values())
         base = 0
         for run in runs:
-            host = {k: np.asarray(v) for k, v in run.outs.items()}
-            err = int(host["err"])
+            # one (8, M) packed array per window — a single transfer
+            # (chunk_compaction packs all per-message outputs + the
+            # err/total scalars into it)
+            p = np.asarray(run.outs["packed"])
+            err = int(p[6, 0])
             if err != L.LERR_OK:
                 raise LaneEngineError(err)
+            host = {
+                "ok": p[0] != 0,
+                "residual": p[1],
+                "append": p[2] != 0,
+                "prev_oid": p[3],
+                "cap_reject": p[4] != 0,
+                "nfill": p[5],
+                "nfill_total": p[7, 0],
+            }
             run.host = host
             run.offs = base + np.cumsum(host["nfill"]) - host["nfill"]
             base += int(host["nfill_total"])
@@ -370,8 +387,10 @@ class LaneSession:
         """On-device observability: cumulative counters (accumulated in
         the scan carry, psum-merged under sharding) + point-in-time
         gauges. One tiny device reduce per call — never per message."""
-        counters = dict(zip(L.METRIC_NAMES,
-                            np.asarray(self.state["metrics"]).tolist()))
+        m = self.state["metrics"]
+        if isinstance(m, tuple):  # compact-mode scalar-tuple carry:
+            m = jax.numpy.stack(m)  # stack on device, ONE transfer
+        counters = dict(zip(L.METRIC_NAMES, np.asarray(m).tolist()))
         gauges = L.build_gauges(self.dev_cfg)(self.state)
         counters.update({k: int(np.asarray(v)) for k, v in gauges.items()})
         return counters
@@ -387,7 +406,12 @@ class LaneSession:
         orders = {}
         S, _, N = s["slot_oid"].shape
         for k in ("pos_amt", "pos_avail"):
-            s[k] = s[k].reshape(S, -1)  # flat (S*A,) device layout
+            if self.dev_cfg.pos_dma:  # planar lo/hi i32 rows -> s64
+                from kme_tpu.ops.rowdma import unpack64_np
+
+                s[k] = unpack64_np(s[k], S)
+            else:
+                s[k] = s[k].reshape(S, -1)  # flat (S*A,) device layout
         # a position exists iff amt != 0 (no-used-flag invariant)
         s["pos_used"] = s["pos_amt"] != 0
         for lane in range(S):

@@ -1,0 +1,93 @@
+"""Trace the COMPACT lane scan step (width W, optional pos_dma) and
+print top device ops by self-time per scan iteration.
+
+Usage: python scripts/trace_compact.py [W] [pos_dma 0|1] [T]
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import kme_tpu._jaxsetup  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kme_tpu.engine import lanes as L
+
+
+def main():
+    W = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    dma = bool(int(sys.argv[2])) if len(sys.argv) > 2 else True
+    T = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+    S, N, A, E = 1024, 128, 2048, 16
+    cfg = L.LaneConfig(lanes=S + 1, slots=N, accounts=A, max_fills=E,
+                       steps=T, width=W, pos_dma=dma)
+    state = L.make_lane_state(cfg)
+    rng = np.random.default_rng(0)
+    lanes = np.stack([rng.choice(S, W, replace=False) for _ in range(T)])
+    batch = {
+        "act": jnp.asarray(rng.integers(0, 3, (T, W)), jnp.int32),
+        "oid": jnp.asarray(rng.integers(1, 1 << 40, (T, W)), jnp.int64),
+        "aid": jnp.asarray(rng.integers(0, A, (T, W)), jnp.int32),
+        "price": jnp.asarray(rng.integers(0, 126, (T, W)), jnp.int32),
+        "size": jnp.asarray(rng.integers(1, 100, (T, W)), jnp.int32),
+        "lane": jnp.asarray(lanes, jnp.int32),
+    }
+    step = jax.jit(L.build_lane_step(cfg))
+    st, outs = step(state, batch)   # compile + warm
+    np.asarray(st["err"])
+    import time
+    t0 = time.perf_counter()
+    st2, _ = step(st, batch)
+    np.asarray(st2["err"])
+    wall = time.perf_counter() - t0
+    print(f"W={W} pos_dma={dma} T={T}: warm wall {wall*1e3:.1f}ms "
+          f"({wall/T*1e6:.1f} us/step incl. RTT)", file=sys.stderr)
+
+    out_dir = f"/tmp/kme_trace_compact_{W}_{int(dma)}"
+    jax.profiler.start_trace(out_dir)
+    st3, outs = step(st2, batch)
+    np.asarray(st3["err"])
+    jax.profiler.stop_trace()
+
+    paths = glob.glob(os.path.join(out_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not paths:
+        print("no trace json found under", out_dir, file=sys.stderr)
+        return
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    dur = defaultdict(float)
+    cnt = defaultdict(int)
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name", "")
+        if "$" in name or ".py" in name:
+            continue  # host events
+        dur[name] += e.get("dur", 0.0)
+        cnt[name] += 1
+    # per-iteration ops: count divisible by T
+    tot = 0.0
+    rows = []
+    for name, d in dur.items():
+        if cnt[name] % T == 0 and cnt[name] > 0:
+            per = d / T
+            tot += per
+            rows.append((per, cnt[name] // T, name))
+    rows.sort(reverse=True)
+    print(f"per-iteration device total: {tot:.1f} us/step", file=sys.stderr)
+    for per, c, name in rows[:25]:
+        print(f"  {per:7.2f} us x{c:2d}  {name}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
