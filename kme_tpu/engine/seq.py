@@ -1,0 +1,1064 @@
+"""Sequential Pallas mega-kernel engine (round 4).
+
+The round-3 profile showed the vectorized sweep engine's scan step is
+OP-COUNT-bound: ~185 XLA ops/step at ~0.25us launch overhead each, with
+occupancy capped at ~4.4 msgs/step by hot-lane serialization under the
+conflict-free scheduler (one message per lane per step). This engine
+removes both limits at once: ONE Pallas kernel processes a micro-batch
+of B messages STRICTLY SEQUENTIALLY — the reference's own execution
+model (KProcessor.java:95-126, single StreamThread) — with the entire
+engine state VMEM-resident for the duration of the call. Sequential
+execution inside the kernel IS serial replay, so no scheduling
+constraints exist at all: same-account runs, hot-symbol bursts and the
+10-account stock harness (exchange_test.js:18) run at full speed
+(SURVEY.md §7 H1 dissolves).
+
+Measured basis (scripts/exp_seqkernel.py, v5e chip): a bare sequential
+sweep body runs at ~64ns/msg — two orders of magnitude under the sweep
+engine's per-step floor.
+
+Semantics: compat='fixed' exactly, mirroring engine/lanes.py (which the
+oracle pins byte-exact) including the capacity envelope (slots /
+max_fills per-message rejects), Q9 prev-echo, Java int32/int64 wrap
+arithmetic, and barrier settles (payout/remove wipe order: buy side
+first, (price, seq) within a side — oracle._wipe_book_fixed).
+
+Data layout (everything int32 — the Mosaic kernel boundary refuses
+s64; 64-bit balance/position values live as planar lo/hi i32 pairs and
+are recombined only in scalar emulation helpers inside the kernel):
+
+- book planes (2*S*NR, 128), row = lane*2*NR + side*NR + r, side 0 =
+  buy, N = NR*128 slots/side: oid lo/hi, aid, price, size, seq.
+  A slot is occupied iff size > 0 (no used flag).
+- positions: an open-addressing HASH TABLE of (CAP,) entries in
+  (CAP/128, 128) planes [key, amt lo/hi, avail lo/hi]; key =
+  lane*A + acc + 1 (0 = empty). Entries are NEVER deleted — a live
+  position has amt != 0 (the delete-at-zero invariant the lanes engine
+  already uses), so lookups need no tombstones; probing is
+  tile-granular linear (scan 128-wide rows from the home tile until
+  key or an empty slot appears). The dense (S, A) alternative is 33MB
+  — VMEM is ~16MB/core, the hash is ~2.6MB at CAP=2^17.
+- balances (A/128, 128) lo/hi/used planes.
+- per-lane seq counters and book-exists flags as (ceil(S/128), 128)
+  planes.
+
+Mosaic constraints that shaped the code (all hit on the real chip,
+see scripts/exp_seqkernel.py): jax_enable_x64 poisons fori_loop
+induction vars / weak int literals / scalar jnp.sum with i64 that the
+lowering cannot convert (use fori32 + np.int32 literals + min/max
+reductions only); i1-vector selects do not legalize (select on i32,
+compare once); with input_output_aliases the OUTPUT VMEM ref starts
+initialized with the input's bytes and state must be read AND written
+through it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+import kme_tpu._jaxsetup  # noqa: F401
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kme_tpu.engine.lanes import (  # noqa: F401 (re-exported act codes)
+    L_NOP, L_BUY, L_SELL, L_CANCEL, L_CREATE, L_TRANSFER, L_ADD_SYMBOL,
+    LERR_OK, LERR_FILLBUF_FULL, METRIC_NAMES, N_METRICS,
+    MET_MSGS, MET_TRADES_OK, MET_FILLS, MET_CONTRACTS, MET_REJ_CAPACITY,
+    MET_REJ_RISK, MET_RESTED, MET_CANCELS_OK, MET_REJ_CANCEL,
+    MET_TRANSFERS_OK, MET_REJ_OTHER, MET_BARRIERS,
+)
+
+# barrier acts (device-executed, unlike the lanes engine where barriers
+# are separate settle calls): mode mapping matches barrier_ops.settle
+L_PAYOUT_YES = 7
+L_PAYOUT_NO = 8
+L_REMOVE_SYMBOL = 9
+
+LERR_HASH_FULL = 4   # position hash exhausted (pos_cap knob)
+
+I32 = jnp.int32
+_i = np.int32
+MIN32 = _i(-(1 << 31))
+BIG = _i(1 << 30)
+LN = 128
+
+_STATE_KEYS = ("bo_lo", "bo_hi", "ba", "bp", "bs", "bq",
+               "seqc", "bex", "bal_lo", "bal_hi", "bal_u",
+               "hk", "ha_lo", "ha_hi", "hv_lo", "hv_hi", "err")
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqConfig:
+    """Static shapes; one Mosaic program per distinct value."""
+
+    lanes: int = 1024          # S symbols
+    slots: int = 128           # N resting orders per side (mult of 128)
+    accounts: int = 2048       # A dense account capacity (mult of 128)
+    max_fills: int = 16        # E makers swept per taker (H3 envelope)
+    batch: int = 4096          # B messages per kernel call (mult of 128)
+    pos_cap: int = 1 << 17     # position hash capacity (pow2 mult of 128)
+    fill_cap: int = 1 << 15    # fill entries per call (mult of 128)
+    probe_max: int = 64        # max hash tiles probed before HASH_FULL
+
+    def __post_init__(self):
+        assert self.slots % LN == 0 and self.slots >= LN
+        assert self.accounts % LN == 0
+        assert self.batch % LN == 0
+        assert self.pos_cap % LN == 0 and (
+            self.pos_cap & (self.pos_cap - 1)) == 0
+        assert self.fill_cap % LN == 0
+        assert self.max_fills <= LN
+        assert self.lanes * self.accounts + self.accounts < (1 << 31), \
+            "hash keys must fit int32"
+
+    @property
+    def nr(self):
+        return self.slots // LN
+
+    @property
+    def srows(self):
+        return -(-self.lanes // LN)
+
+    @property
+    def arows(self):
+        return self.accounts // LN
+
+    @property
+    def caprows(self):
+        return self.pos_cap // LN
+
+
+def make_seq_state(cfg: SeqConfig):
+    S, NR = cfg.lanes, cfg.nr
+    z = lambda r: jnp.zeros((r, LN), I32)
+    return {
+        "bo_lo": z(2 * S * NR), "bo_hi": z(2 * S * NR), "ba": z(2 * S * NR),
+        "bp": z(2 * S * NR), "bs": z(2 * S * NR), "bq": z(2 * S * NR),
+        "seqc": z(cfg.srows), "bex": z(cfg.srows),
+        "bal_lo": z(cfg.arows), "bal_hi": z(cfg.arows), "bal_u": z(cfg.arows),
+        "hk": z(cfg.caprows), "ha_lo": z(cfg.caprows), "ha_hi": z(cfg.caprows),
+        "hv_lo": z(cfg.caprows), "hv_hi": z(cfg.caprows),
+        "err": z(1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# output plane layout (host unpack in unpack_out)
+
+def out_rows(cfg: SeqConfig):
+    BR, FR = cfg.batch // LN, cfg.fill_cap // LN
+    return 5 * BR + 5 * FR + 1
+
+
+# ---------------------------------------------------------------------------
+# kernel-side helpers (scalar i64 emulation on i32 pairs etc.)
+
+def _fori32(n, body, init):
+    """while_loop with an np.int32 counter (see module docstring)."""
+    def cond(c):
+        return c[0] < _i(n)
+
+    def step(c):
+        i, carry = c
+        return i + _i(1), body(i, carry)
+
+    return jax.lax.while_loop(cond, step, (_i(0), init))[1]
+
+
+def _u_lt(a, b):
+    return (a ^ MIN32) < (b ^ MIN32)
+
+
+def _add64(alo, ahi, blo, bhi):
+    rlo = alo + blo
+    carry = _u_lt(rlo, alo).astype(I32)
+    return rlo, ahi + bhi + carry
+
+
+def _sx(v):
+    """sign-extend i32 scalar to an (lo, hi) pair."""
+    return v, v >> _i(31)
+
+
+def _neg64(lo, hi):
+    return -lo, ~hi + (lo == _i(0)).astype(I32)
+
+
+def _lt64(alo, ahi, blo, bhi):
+    return (ahi < bhi) | ((ahi == bhi) & _u_lt(alo, blo))
+
+
+def _sel64(c, a, b):
+    return jnp.where(c, a[0], b[0]), jnp.where(c, a[1], b[1])
+
+
+def _min64(a, b):
+    return _sel64(_lt64(*a, *b), a, b)
+
+
+def _max64(a, b):
+    return _sel64(_lt64(*a, *b), b, a)
+
+
+def _muls64(a, b):
+    """Exact i64 product of i32 `a` and SMALL i32 `b` (|b| <= ~2^14):
+    16-bit split keeps every partial in i32 range."""
+    t1 = (a & _i(0xFFFF)) * b            # [0, 2^16) * b
+    t2 = (a >> _i(16)) * b               # [-2^15, 2^15) * b
+    return _add64(t2 << _i(16), t2 >> _i(16), *_sx(t1))
+
+
+def _mul64(alo, ahi, blo, bhi):
+    """Full 64x64 -> 64 wrap product (Java long multiply) via 8-bit
+    limbs — every limb product < 2^16 and limb accumulators stay far
+    inside i32. Only the rare payout credit path uses this."""
+    M = _i(0xFF)
+    a = [(alo >> _i(8 * k)) & M for k in range(4)] + \
+        [(ahi >> _i(8 * k)) & M for k in range(4)]
+    b = [(blo >> _i(8 * k)) & M for k in range(4)] + \
+        [(bhi >> _i(8 * k)) & M for k in range(4)]
+    limbs = []
+    carry = _i(0)
+    for k in range(8):
+        acc = carry
+        for i2 in range(k + 1):
+            acc = acc + a[i2] * b[k - i2]
+        limbs.append(acc & M)
+        carry = acc >> _i(8)
+    lo = limbs[0] | (limbs[1] << _i(8)) | (limbs[2] << _i(16)) \
+        | (limbs[3] << _i(24))
+    hi = limbs[4] | (limbs[5] << _i(8)) | (limbs[6] << _i(16)) \
+        | (limbs[7] << _i(24))
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+
+@functools.lru_cache(maxsize=None)
+def build_seq_step(cfg: SeqConfig):
+    """Returns the jitted (state, msgs) -> (state, out_plane) callable.
+
+    msgs: dict of (B,) int32 arrays act/oid_lo/oid_hi/aid/price/size/
+    lane (host router output; padding entries carry act = L_NOP).
+    out_plane: (out_rows, 128) int32 — see unpack_out.
+    """
+    S, NR, E, B = cfg.lanes, cfg.nr, cfg.max_fills, cfg.batch
+    A, CAPR, FB = cfg.accounts, cfg.caprows, cfg.fill_cap
+    BR, FR = B // LN, FB // LN
+    NROWS = out_rows(cfg)
+    PROBE = min(cfg.probe_max, CAPR)
+    CAPMASK = _i(cfg.pos_cap - 1)
+
+    def kernel(act_s, oidlo_s, oidhi_s, aid_s, price_s, size_s, lane_s,
+               *refs):
+        # refs: 17 aliased state ins, then 17 state outs + out plane.
+        outs = refs[len(_STATE_KEYS):]
+        st = dict(zip(_STATE_KEYS, outs[:len(_STATE_KEYS)]))
+        out = outs[len(_STATE_KEYS)]
+
+        ci = jax.lax.broadcasted_iota(I32, (1, LN), 1)
+        # flat slot index over an (NR, 128) side block
+        fi = (jax.lax.broadcasted_iota(I32, (NR, LN), 0) * _i(LN)
+              + jax.lax.broadcasted_iota(I32, (NR, LN), 1))
+
+        def pick(row, l):
+            """exact scalar extract from a (1,128) row at lane l."""
+            return MIN32 ^ jnp.max(
+                jnp.where(ci == l, row ^ MIN32, MIN32))
+
+        def pick2(blk, f):
+            """extract from an (NR,128) block at flat index f."""
+            return MIN32 ^ jnp.max(
+                jnp.where(fi == f, blk ^ MIN32, MIN32))
+
+        def put(ref, r, l, v):
+            row = ref[pl.ds(r, 1), :]
+            ref[pl.ds(r, 1), :] = jnp.where(ci == l, v, row)
+
+        def rget(ref, r, l):
+            return pick(ref[pl.ds(r, 1), :], l)
+
+        def set_err(code):
+            r0 = st["err"][0:1, :]
+            st["err"][0:1, :] = jnp.where(
+                (ci == _i(0)) & (r0 == _i(LERR_OK)), code, r0)
+
+        # -------- balances (row r = acc >> 7, lane l = acc & 127)
+        def bal_get(acc):
+            r, l = acc >> _i(7), acc & _i(127)
+            return rget(st["bal_lo"], r, l), rget(st["bal_hi"], r, l)
+
+        def bal_add(acc, dlo, dhi):
+            r, l = acc >> _i(7), acc & _i(127)
+            lo, hi = rget(st["bal_lo"], r, l), rget(st["bal_hi"], r, l)
+            nlo, nhi = _add64(lo, hi, dlo, dhi)
+            put(st["bal_lo"], r, l, nlo)
+            put(st["bal_hi"], r, l, nhi)
+
+        # -------- position hash ---------------------------------------
+        def h_home(key):
+            # Fibonacci hash, tile-granular
+            return ((key * _i(-1640531527)) >> _i(7)) & (CAPMASK >> _i(7))
+
+        def h_find(key):
+            """-> (flat entry index or -1, err_flag). Scans tiles from
+            the home tile until the key or an empty slot appears."""
+            def body(c):
+                t, probes, res, done = c
+                krow = st["hk"][pl.ds(t, 1), :]
+                hit = krow == key
+                hidx = jnp.min(jnp.where(hit, ci, BIG))
+                empty = jnp.min(jnp.where(krow == _i(0), ci, BIG))
+                found = hidx < BIG
+                stop = found | (empty < BIG) | (probes + _i(1) >= _i(PROBE))
+                res = jnp.where(found, t * _i(LN) + hidx, res)
+                return ((t + _i(1)) & (CAPMASK >> _i(7)), probes + _i(1),
+                        res, stop)
+
+            t0 = h_home(key)
+            _, probes, res, _ = jax.lax.while_loop(
+                lambda c: ~c[3], body, (t0, _i(0), _i(-1), False))
+            return res, (res < _i(0)) & (probes >= _i(PROBE))
+
+        def h_claim(key):
+            """find-or-insert -> (flat index, err_flag)."""
+            def body(c):
+                t, probes, res, done = c
+                krow = st["hk"][pl.ds(t, 1), :]
+                hit = krow == key
+                hidx = jnp.min(jnp.where(hit, ci, BIG))
+                empty = jnp.min(jnp.where(krow == _i(0), ci, BIG))
+                found = hidx < BIG
+                can_ins = ~found & (empty < BIG)
+                res = jnp.where(found, t * _i(LN) + hidx, res)
+                res = jnp.where(can_ins, t * _i(LN) + empty, res)
+
+                @pl.when(can_ins)
+                def _():
+                    put(st["hk"], t, empty, key)
+
+                stop = found | can_ins | (probes + _i(1) >= _i(PROBE))
+                return ((t + _i(1)) & (CAPMASK >> _i(7)), probes + _i(1),
+                        res, stop)
+
+            t0 = h_home(key)
+            _, probes, res, _ = jax.lax.while_loop(
+                lambda c: ~c[3], body, (t0, _i(0), _i(-1), False))
+            return res, res < _i(0)
+
+        def pos_key(lane, acc):
+            return lane * _i(A) + acc + _i(1)
+
+        def pos_get(lane, acc):
+            """-> (amt lo, hi, avail lo, hi); zeros when absent."""
+            e, _err = h_find(pos_key(lane, acc))
+            r, l = e >> _i(7), e & _i(127)
+            there = e >= _i(0)
+            rr = jnp.where(there, r, _i(0))
+            z = _i(0)
+            alo = jnp.where(there, rget(st["ha_lo"], rr, l), z)
+            ahi = jnp.where(there, rget(st["ha_hi"], rr, l), z)
+            vlo = jnp.where(there, rget(st["hv_lo"], rr, l), z)
+            vhi = jnp.where(there, rget(st["hv_hi"], rr, l), z)
+            return alo, ahi, vlo, vhi
+
+        def pos_set(lane, acc, alo, ahi, vlo, vhi):
+            """write a position (claiming a slot if new) -> err_flag."""
+            e, err = h_claim(pos_key(lane, acc))
+            r, l = jnp.where(e >= _i(0), e >> _i(7), _i(0)), e & _i(127)
+
+            @pl.when(e >= _i(0))
+            def _():
+                put(st["ha_lo"], r, l, alo)
+                put(st["ha_hi"], r, l, ahi)
+                put(st["hv_lo"], r, l, vlo)
+                put(st["hv_hi"], r, l, vhi)
+
+            return err
+
+        def fill_one(lane, acc, sgn_fill):
+            """fillOrder's position half (KProcessor.java:276-287),
+            fixed mode: create == update-from-(0,0); delete-at-zero
+            writes (0,0). sgn_fill: signed i32 size. -> err_flag."""
+            alo, ahi, vlo, vhi = pos_get(lane, acc)
+            nalo, nahi = _add64(alo, ahi, *_sx(sgn_fill))
+            nvlo, nvhi = _add64(vlo, vhi, *_sx(sgn_fill))
+            dead = (nalo == _i(0)) & (nahi == _i(0))
+            z = _i(0)
+            return pos_set(lane, acc,
+                           nalo, nahi,
+                           jnp.where(dead, z, nvlo),
+                           jnp.where(dead, z, nvhi))
+
+        # -------- book row access -------------------------------------
+        def side_base(lane, side):
+            return lane * _i(2 * NR) + side * _i(NR)
+
+        def side_blk(ref, lane, side):
+            return ref[pl.ds(side_base(lane, side), NR), :]
+
+        def side_put(ref, lane, side, blk):
+            ref[pl.ds(side_base(lane, side), NR), :] = blk
+
+        def slot_write(ref, lane, side, f, v):
+            blk = side_blk(ref, lane, side)
+            side_put(ref, lane, side, jnp.where(fi == f, v, blk))
+
+        # -------- margin release shared by cancel + wipe --------------
+        def release_margin(lane, acc, o_isbuy, o_price, o_size):
+            """postRemoveAdjustments (KProcessor.java:325-333): returns
+            the balance credit and applies the avail adjustment."""
+            signed = jnp.where(o_isbuy, o_size, -o_size)
+            alo, ahi, vlo, vhi = pos_get(lane, acc)
+            blo, bhi = _add64(alo, ahi, *_neg64(vlo, vhi))  # blocked
+            z64 = (_i(0), _i(0))
+            nsg = _neg64(*_sx(signed))
+            adjlo, adjhi = _sel64(
+                o_isbuy,
+                _max64(_min64((blo, bhi), z64), nsg),
+                _min64(_max64((blo, bhi), z64), nsg))
+            unit = jnp.where(o_isbuy, o_price, o_price - _i(100))
+            rel_lo, rel_hi = _muls64(signed + adjlo, unit)
+            adj_nz = (adjlo != _i(0)) | (adjhi != _i(0))
+
+            err = _i(0)
+
+            @pl.when(adj_nz)
+            def _():
+                nvlo, nvhi = _add64(vlo, vhi, adjlo, adjhi)
+                e = pos_set(lane, acc, alo, ahi, nvlo, nvhi)
+                # adj_nz requires an existing position (amt != 0 or
+                # avail != 0 implies the entry exists), so pos_set can
+                # only fail if the hash itself is broken — fold into
+                # the sticky error anyway via the out-of-band plane
+                @pl.when(e)
+                def _():
+                    set_err(_i(LERR_HASH_FULL))
+
+            return rel_lo, rel_hi
+
+        # -------- output row helpers ----------------------------------
+        def out_put(region_row, m, v):
+            r = region_row + (m >> _i(7))
+            put(out, r, m & _i(127), v)
+
+        def fill_put(field, p, v):
+            r = _i(5 * BR + field * FR) + (p >> _i(7))
+            put(out, r, p & _i(127), v)
+
+        # ==============================================================
+        def one(m, carry):
+            (fill_total, met) = carry
+            act = act_s[m]
+            lane = lane_s[m]
+            acc = aid_s[m]
+            limit = price_s[m]
+            size = size_s[m]
+            t_oidlo = oidlo_s[m]
+            t_oidhi = oidhi_s[m]
+
+            is_trade = (act == _i(L_BUY)) | (act == _i(L_SELL))
+            is_buy = act == _i(L_BUY)
+            is_cancel = act == _i(L_CANCEL)
+            is_barrier = ((act == _i(L_PAYOUT_YES))
+                          | (act == _i(L_PAYOUT_NO))
+                          | (act == _i(L_REMOVE_SYMBOL)))
+            side = jnp.where(is_buy, _i(0), _i(1))
+            opp = _i(1) - side
+            # sgn: buy -> +1 (low ask first), sell -> -1 (high bid first)
+            sgn = jnp.where(is_buy, _i(1), _i(-1))
+
+            lr, ll = lane >> _i(7), lane & _i(127)
+            bex_v = rget(st["bex"], lr, ll) != _i(0)
+
+            blo, bhi = bal_get(acc)
+            bal_ok = rget(st["bal_u"], acc >> _i(7), acc & _i(127)) != _i(0)
+
+            # ---------------- CREATE / TRANSFER / ADD_SYMBOL ----------
+            create_ok = (act == _i(L_CREATE)) & ~bal_ok
+            neg_sz = -size  # Java int negation (wraps at INT_MIN)
+            transfer_ok = ((act == _i(L_TRANSFER)) & bal_ok
+                           & ~_lt64(blo, bhi, *_sx(neg_sz)))
+            addsym_ok = (act == _i(L_ADD_SYMBOL)) & ~bex_v
+
+            @pl.when(create_ok)
+            def _():
+                put(st["bal_u"], acc >> _i(7), acc & _i(127), _i(1))
+
+            @pl.when(transfer_ok)
+            def _():
+                bal_add(acc, *_sx(size))
+
+            @pl.when(addsym_ok)
+            def _():
+                put(st["bex"], lr, ll, _i(1))
+
+            # ---------------- TRADE: margin (checkBalance) ------------
+            valid = (limit >= _i(0)) & (limit < _i(126)) & (size > _i(0))
+            signed = jnp.where(is_buy, size, -size)
+            palo, pahi, pvlo, pvhi = pos_get(lane, acc)
+            z64 = (_i(0), _i(0))
+            nsg = _neg64(*_sx(signed))
+            adjlo, adjhi = _sel64(
+                is_buy,
+                _max64(_min64((pvlo, pvhi), z64), nsg),
+                _min64(_max64((pvlo, pvhi), z64), nsg))
+            unit = jnp.where(is_buy, limit, limit - _i(100))
+            risk_lo, risk_hi = _muls64(signed + adjlo, unit)
+            trade_ok = (is_trade & valid & bex_v & bal_ok
+                        & ~_lt64(blo, bhi, risk_lo, risk_hi))
+
+            # ---------------- TRADE phase 1: non-mutating sweep -------
+            op_blk = side_blk(st["bp"], lane, opp)
+            os_blk = side_blk(st["bs"], lane, opp)
+            oq_blk = side_blk(st["bq"], lane, opp)
+
+            def sweep(c):
+                wsize, fslot, ffill, remaining, e, ovf, done = c
+                cross = (wsize > _i(0)) & (
+                    (op_blk - limit) * sgn <= _i(0))
+                pstar = jnp.min(jnp.where(cross, op_blk * sgn, BIG))
+                anyc = (pstar < BIG) & (remaining > _i(0))
+                at = cross & (op_blk * sgn == pstar)
+                sstar = jnp.min(jnp.where(at, oq_blk, BIG))
+                at2 = at & (oq_blk == sstar)
+                flat = jnp.min(jnp.where(at2, fi, BIG))
+                have = pick2(wsize, flat)
+                fill = jnp.minimum(remaining, have)
+                exceed = anyc & (e >= _i(E))
+                take = anyc & ~exceed
+                wsize = jnp.where(take & (fi == flat), wsize - fill, wsize)
+                fslot = jnp.where(take & (ci == e), flat, fslot)
+                ffill = jnp.where(take & (ci == e), fill, ffill)
+                remaining = remaining - jnp.where(take, fill, _i(0))
+                e = e + jnp.where(take, _i(1), _i(0))
+                ovf = ovf | exceed
+                done = (~anyc) | exceed | (remaining == _i(0))
+                return wsize, fslot, ffill, remaining, e, ovf, done
+
+            want = jnp.where(trade_ok, size, _i(0))
+            init = (os_blk, jnp.zeros((1, LN), I32), jnp.zeros((1, LN), I32),
+                    want, _i(0), False, want == _i(0))
+            wsize, fslot, ffill, residual_t, nfill, ovf_fills, _d = \
+                jax.lax.while_loop(lambda c: ~c[6], sweep, init)
+
+            # ---------------- capacity envelope + Q9 ------------------
+            w_blk = side_blk(st["bs"], lane, side)      # own side sizes
+            wp_blk = side_blk(st["bp"], lane, side)
+            wq_blk = side_blk(st["bq"], lane, side)
+            free_flat = jnp.min(jnp.where(w_blk == _i(0), fi, BIG))
+            have_free = free_flat < BIG
+            rest_want = trade_ok & (residual_t > _i(0))
+            ovf_book = rest_want & ~have_free
+            cap_reject = trade_ok & (ovf_fills | ovf_book)
+            trade_acc = trade_ok & ~cap_reject
+            do_rest = rest_want & trade_acc
+
+            same_level = (w_blk > _i(0)) & (wp_blk == limit)
+            bucket_nonempty = jnp.max(
+                jnp.where(same_level, _i(1), _i(0))) == _i(1)
+            smax = jnp.max(jnp.where(same_level, wq_blk, _i(-1)))
+            tail_at = same_level & (wq_blk == smax)
+            tail_flat = jnp.min(jnp.where(tail_at, fi, BIG))
+            tfc = jnp.where(bucket_nonempty, tail_flat, _i(0))
+            tail_lo = pick2(side_blk(st["bo_lo"], lane, side), tfc)
+            tail_hi = pick2(side_blk(st["bo_hi"], lane, side), tfc)
+            append = bucket_nonempty & do_rest
+
+            # ---------------- TRADE phase 2: apply --------------------
+            @pl.when(trade_acc)
+            def _():
+                # checkBalance debit + adj-write (before the fills, the
+                # reference's order — final state is order-invariant
+                # but the position write must precede fill updates of
+                # the SAME key)
+                bal_add(acc, *_neg64(risk_lo, risk_hi))
+                adj_nz = (adjlo != _i(0)) | (adjhi != _i(0))
+
+                @pl.when(adj_nz)
+                def _():
+                    nvlo, nvhi = _add64(pvlo, pvhi, *_neg64(adjlo, adjhi))
+                    e = pos_set(lane, acc, palo, pahi, nvlo, nvhi)
+
+                    @pl.when(e)
+                    def _():
+                        set_err(_i(LERR_HASH_FULL))
+
+                # maker size writeback (size==0 deletes the slot)
+                side_put(st["bs"], lane, opp, wsize)
+
+                oa_blk = side_blk(st["ba"], lane, opp)
+                olo_blk = side_blk(st["bo_lo"], lane, opp)
+                ohi_blk = side_blk(st["bo_hi"], lane, opp)
+
+                def apply_fill(e2, _c):
+                    flat = pick(fslot, e2)
+                    fill = pick(ffill, e2)
+                    maid = pick2(oa_blk, flat)
+                    mprice = pick2(op_blk, flat)
+                    p = fill_total + e2
+                    pc = jnp.minimum(p, _i(FB - 1))
+
+                    @pl.when(p < _i(FB))
+                    def _():
+                        fill_put(0, pc, pick2(olo_blk, flat))
+                        fill_put(1, pc, pick2(ohi_blk, flat))
+                        fill_put(2, pc, maid)
+                        fill_put(3, pc, mprice)
+                        fill_put(4, pc, fill)
+
+                    # maker fill then taker fill (executeTrade order)
+                    me = fill_one(lane, maid, jnp.where(is_buy, -fill, fill))
+                    te = fill_one(lane, acc, jnp.where(is_buy, fill, -fill))
+                    # taker credit: int*int wraps at i32 before the
+                    # long add (KProcessor.java:286); maker credit is 0
+                    tsz = jnp.where(is_buy, fill, -fill)
+                    bal_add(acc, *_sx(tsz * (limit - mprice)))
+
+                    @pl.when(me | te)
+                    def _():
+                        set_err(_i(LERR_HASH_FULL))
+
+                    return _c
+
+                jax.lax.while_loop(
+                    lambda c: c[0] < nfill,
+                    lambda c: (c[0] + _i(1), apply_fill(c[0], c[1])),
+                    (_i(0), _i(0)))
+
+                @pl.when(fill_total + nfill > _i(FB))
+                def _():
+                    set_err(_i(LERR_FILLBUF_FULL))
+
+                # rest the residual
+                @pl.when(do_rest)
+                def _():
+                    seqv = rget(st["seqc"], lr, ll)
+                    slot_write(st["bo_lo"], lane, side, free_flat, t_oidlo)
+                    slot_write(st["bo_hi"], lane, side, free_flat, t_oidhi)
+                    slot_write(st["ba"], lane, side, free_flat, acc)
+                    slot_write(st["bp"], lane, side, free_flat, limit)
+                    slot_write(st["bs"], lane, side, free_flat, residual_t)
+                    slot_write(st["bq"], lane, side, free_flat, seqv)
+                    put(st["seqc"], lr, ll, seqv + _i(1))
+
+            # ---------------- CANCEL ----------------------------------
+            # search both sides for the oid among occupied slots
+            b0 = side_blk(st["bo_lo"], lane, _i(0))
+            b0h = side_blk(st["bo_hi"], lane, _i(0))
+            s0 = side_blk(st["bs"], lane, _i(0))
+            b1 = side_blk(st["bo_lo"], lane, _i(1))
+            b1h = side_blk(st["bo_hi"], lane, _i(1))
+            s1 = side_blk(st["bs"], lane, _i(1))
+            hit0 = (s0 > _i(0)) & (b0 == t_oidlo) & (b0h == t_oidhi)
+            hit1 = (s1 > _i(0)) & (b1 == t_oidlo) & (b1h == t_oidhi)
+            f0 = jnp.min(jnp.where(hit0, fi, BIG))
+            f1 = jnp.min(jnp.where(hit1, fi, BIG))
+            c_side = jnp.where(f0 < BIG, _i(0), _i(1))
+            c_flat = jnp.where(f0 < BIG, f0, f1)
+            hit_any = is_cancel & (c_flat < BIG)
+            cfc = jnp.where(hit_any, c_flat, _i(0))
+            c_aid = pick2(side_blk(st["ba"], lane, c_side), cfc)
+            c_price = pick2(side_blk(st["bp"], lane, c_side), cfc)
+            c_size = pick2(side_blk(st["bs"], lane, c_side), cfc)
+            cancel_ok = hit_any & (c_aid == acc)
+
+            @pl.when(cancel_ok)
+            def _():
+                slot_write(st["bs"], lane, c_side, c_flat, _i(0))
+                rlo, rhi = release_margin(lane, acc, c_side == _i(0),
+                                          c_price, c_size)
+                bal_add(acc, rlo, rhi)
+
+            # ---------------- BARRIERS (payout / remove) --------------
+            barrier_do = is_barrier & bex_v
+
+            @pl.when(barrier_do)
+            def _():
+                # wipe both sides with margin release, buy side first,
+                # (price, seq) order within a side (_wipe_book_fixed)
+                def wipe_side(wside):
+                    pb = side_blk(st["bp"], lane, wside)
+                    qb = side_blk(st["bq"], lane, wside)
+                    ab = side_blk(st["ba"], lane, wside)
+
+                    def w_body(c):
+                        _k, done = c
+                        sb = side_blk(st["bs"], lane, wside)
+                        used = sb > _i(0)
+                        pmin = jnp.min(jnp.where(used, pb, BIG))
+                        anyu = pmin < BIG
+
+                        pm = jnp.where(anyu, pmin, _i(0))
+                        at = used & (pb == pm)
+                        smin = jnp.min(jnp.where(at, qb, BIG))
+                        at2 = at & (qb == smin)
+                        flat = jnp.min(jnp.where(at2, fi, BIG))
+                        fc = jnp.where(anyu, flat, _i(0))
+
+                        @pl.when(anyu)
+                        def _():
+                            o_aid = pick2(ab, fc)
+                            o_price = pick2(pb, fc)
+                            o_size = pick2(sb, fc)
+                            slot_write(st["bs"], lane, wside, fc, _i(0))
+                            rlo, rhi = release_margin(
+                                lane, o_aid, wside == _i(0),
+                                o_price, o_size)
+                            bal_add(o_aid, rlo, rhi)
+
+                        return _k + _i(1), ~anyu
+
+                    jax.lax.while_loop(lambda c: ~c[1], w_body,
+                                       (_i(0), False))
+
+                wipe_side(_i(0))
+                wipe_side(_i(1))
+                put(st["bex"], lr, ll, _i(0))
+
+                # payout: credit (YES) / just delete (NO) the lane's
+                # positions — hash scan; entries keep their keys, a
+                # zeroed amt/avail IS deletion (the absence invariant)
+                is_payout = act != _i(L_REMOVE_SYMBOL)
+                do_credit = act == _i(L_PAYOUT_YES)
+
+                @pl.when(is_payout)
+                def _():
+                    klo = lane * _i(A) + _i(1)
+
+                    def scan_row(tr, _c):
+                        krow = st["hk"][pl.ds(tr, 1), :]
+                        mine = (krow >= klo) & (krow < klo + _i(A))
+                        arow_lo = st["ha_lo"][pl.ds(tr, 1), :]
+                        arow_hi = st["ha_hi"][pl.ds(tr, 1), :]
+                        live = mine & ((arow_lo != _i(0))
+                                       | (arow_hi != _i(0)))
+
+                        @pl.when(do_credit
+                                 & (jnp.max(jnp.where(live, _i(1), _i(0)))
+                                    == _i(1)))
+                        def _():
+                            def credit_one(c):
+                                rem, done = c
+                                l2 = jnp.min(jnp.where(
+                                    rem > _i(0), ci, BIG))
+                                anyl = l2 < BIG
+                                lc = jnp.where(anyl, l2, _i(0))
+
+                                @pl.when(anyl)
+                                def _():
+                                    a2lo = pick(arow_lo, lc)
+                                    a2hi = pick(arow_hi, lc)
+                                    acc2 = pick(krow, lc) - klo
+                                    plo, phi = _mul64(a2lo, a2hi,
+                                                      *_sx(size))
+                                    bal_add(acc2, plo, phi)
+
+                                rem = jnp.where(ci == lc, _i(0), rem)
+                                return rem, ~anyl
+
+                            jax.lax.while_loop(
+                                lambda c: ~c[1], credit_one,
+                                (jnp.where(live, _i(1), _i(0)), False))
+
+                        # delete: zero amt + avail where mine
+                        st["ha_lo"][pl.ds(tr, 1), :] = jnp.where(
+                            mine, _i(0), arow_lo)
+                        st["ha_hi"][pl.ds(tr, 1), :] = jnp.where(
+                            mine, _i(0), arow_hi)
+                        vr_lo = st["hv_lo"][pl.ds(tr, 1), :]
+                        vr_hi = st["hv_hi"][pl.ds(tr, 1), :]
+                        st["hv_lo"][pl.ds(tr, 1), :] = jnp.where(
+                            mine, _i(0), vr_lo)
+                        st["hv_hi"][pl.ds(tr, 1), :] = jnp.where(
+                            mine, _i(0), vr_hi)
+                        return _c
+
+                    _fori32(CAPR, scan_row, _i(0))
+
+            # ---------------- outputs + metrics -----------------------
+            ok = jnp.where(
+                is_trade, trade_acc,
+                jnp.where(is_cancel, cancel_ok,
+                          jnp.where(act == _i(L_CREATE), create_ok,
+                                    jnp.where(act == _i(L_TRANSFER),
+                                              transfer_ok,
+                                              jnp.where(
+                                                  act == _i(L_ADD_SYMBOL),
+                                                  addsym_ok,
+                                                  jnp.where(
+                                                      is_barrier,
+                                                      barrier_do,
+                                                      act == _i(L_NOP)))))))
+            flags = (ok.astype(I32) | (cap_reject.astype(I32) << _i(1))
+                     | (append.astype(I32) << _i(2)))
+            out_put(_i(0), m, flags)
+            out_put(_i(BR), m, jnp.where(trade_acc, residual_t, size))
+            out_put(_i(2 * BR), m, jnp.where(trade_acc, nfill, _i(0)))
+            out_put(_i(3 * BR), m, tail_lo)
+            out_put(_i(4 * BR), m, tail_hi)
+
+            filled = jnp.where(trade_acc, size - residual_t, _i(0))
+            nf = jnp.where(trade_acc, nfill, _i(0))
+            cnt = lambda c: c.astype(I32)
+            met = (
+                met[0] + cnt(act != _i(L_NOP)),
+                met[1] + cnt(trade_acc),
+                met[2] + nf,
+                met[3] + filled,
+                met[4] + cnt(cap_reject),
+                met[5] + cnt(is_trade & ~trade_ok),
+                met[6] + cnt(do_rest),
+                met[7] + cnt(cancel_ok),
+                met[8] + cnt(is_cancel & ~cancel_ok),
+                met[9] + cnt(transfer_ok),
+                met[10] + cnt(((act == _i(L_CREATE)) & ~create_ok)
+                              | ((act == _i(L_TRANSFER)) & ~transfer_ok)
+                              | ((act == _i(L_ADD_SYMBOL)) & ~addsym_ok)),
+                met[11] + cnt(barrier_do),
+            )
+            fill_total2 = fill_total + nf
+            return (fill_total2, met)
+
+        met0 = tuple(_i(0) for _ in range(N_METRICS))
+        fill_total, met = _fori32(B, one, (_i(0), met0))
+
+        # scalar row: lane0 err, lane1 fill_total, lanes 2.. metrics
+        errv = pick(st["err"][0:1, :], _i(0))
+        scal = jnp.where(ci == _i(0), errv, _i(0))
+        scal = jnp.where(ci == _i(1), fill_total, scal)
+        for k in range(N_METRICS):
+            scal = jnp.where(ci == _i(2 + k), met[k], scal)
+        out[NROWS - 1:NROWS, :] = scal
+
+    nstate = len(_STATE_KEYS)
+
+    def call(state, msgs):
+        outs = pl.pallas_call(
+            kernel,
+            out_shape=tuple(
+                [jax.ShapeDtypeStruct(state[k].shape, I32)
+                 for k in _STATE_KEYS]
+                + [jax.ShapeDtypeStruct((NROWS, LN), I32)]),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 7
+            + [pl.BlockSpec(memory_space=pltpu.VMEM)] * nstate,
+            out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)]
+                            * (nstate + 1)),
+            input_output_aliases={7 + k: k for k in range(nstate)},
+            interpret=jax.default_backend() != "tpu",
+        )(msgs["act"], msgs["oid_lo"], msgs["oid_hi"], msgs["aid"],
+          msgs["price"], msgs["size"], msgs["lane"],
+          *[state[k] for k in _STATE_KEYS])
+        new_state = dict(zip(_STATE_KEYS, outs[:nstate]))
+        return new_state, outs[nstate]
+
+    # NOTE: jit-level donation composes badly with the pallas-level
+    # input_output_aliases (the donated state buffers get clobbered and
+    # the aliased outputs read zeros — observed under interpret); the
+    # aliasing alone keeps the in-kernel copy semantics, at the cost of
+    # one XLA copy of the state per call (~10MB, ~12us on v5e).
+    return jax.jit(call)
+
+
+# ---------------------------------------------------------------------------
+# host-side packing / unpacking
+
+def pack_msgs(cfg: SeqConfig, cols: dict, n: int) -> dict:
+    """Columnar router output (numpy, length n <= batch) -> padded
+    (B,) i32 input dict. Padding entries are NOPs."""
+    B = cfg.batch
+    out = {}
+    for k in ("act", "aid", "price", "size", "lane"):
+        a = np.zeros(B, np.int32)
+        a[:n] = cols[k][:n]
+        out[k] = a
+    oid = np.zeros(B, np.int64)
+    oid[:n] = cols["oid"][:n]
+    out["oid_lo"] = (oid & 0xFFFFFFFF).astype(np.uint32).astype(np.int32)
+    out["oid_hi"] = (oid >> 32).astype(np.int32)
+    return out
+
+
+def unpack_out(cfg: SeqConfig, plane: np.ndarray, n: int) -> dict:
+    """(out_rows, 128) i32 -> host dict for reconstruction."""
+    B, FB = cfg.batch, cfg.fill_cap
+    BR, FR = B // LN, FB // LN
+    flat = plane.reshape(-1)
+    flags = flat[:B][:n]
+    res = {
+        "ok": (flags & 1) != 0,
+        "cap_reject": (flags & 2) != 0,
+        "append": (flags & 4) != 0,
+        "residual": flat[BR * LN:BR * LN + B][:n],
+        "nfill": flat[2 * BR * LN:2 * BR * LN + B][:n],
+        "prev_oid": ((flat[3 * BR * LN:3 * BR * LN + B][:n].astype(np.int64)
+                      & 0xFFFFFFFF)
+                     | (flat[4 * BR * LN:4 * BR * LN + B][:n]
+                        .astype(np.int64) << 32)),
+    }
+    fbase = 5 * BR * LN
+    fills = flat[fbase:fbase + 5 * FB].reshape(5, FB)
+    scal = flat[-LN:]
+    err, ftot = int(scal[0]), int(scal[1])
+    res["err"] = err
+    res["fill_total"] = ftot
+    res["metrics"] = scal[2:2 + N_METRICS].astype(np.int64)
+    f_oid = ((fills[0, :ftot].astype(np.int64) & 0xFFFFFFFF)
+             | (fills[1, :ftot].astype(np.int64) << 32))
+    res["fills"] = np.stack([
+        f_oid,
+        fills[2, :ftot].astype(np.int64),
+        fills[3, :ftot].astype(np.int64),
+        fills[4, :ftot].astype(np.int64)])
+    return res
+
+
+# ---------------------------------------------------------------------------
+# canonical (lanes-style) state import/export for checkpoint parity
+
+def export_canonical(cfg: SeqConfig, state) -> dict:
+    """Device planes -> the canonical snapshot layout the lanes engine
+    checkpoints use (slot_* (S,2,N) i64/i32/bool, flat positions s64,
+    bal s64) so snapshots restore across engines."""
+    S, N, A, NR = cfg.lanes, cfg.slots, cfg.accounts, cfg.nr
+    h = {k: np.asarray(state[k]) for k in _STATE_KEYS}
+
+    def planes2slot(lo, hi=None):
+        v = lo.reshape(S, 2, NR * LN)[:, :, :N]
+        if hi is None:
+            return v
+        return ((v.astype(np.int64) & 0xFFFFFFFF)
+                | (hi.reshape(S, 2, NR * LN)[:, :, :N].astype(np.int64)
+                   << 32))
+
+    slot_size = planes2slot(h["bs"]).astype(np.int32)
+    used = slot_size > 0
+    pos_amt = np.zeros(S * A, np.int64)
+    pos_avail = np.zeros(S * A, np.int64)
+    hk = h["hk"].reshape(-1)
+    live = hk != 0
+    keys = hk[live] - 1
+    amt = ((h["ha_lo"].reshape(-1)[live].astype(np.int64) & 0xFFFFFFFF)
+           | (h["ha_hi"].reshape(-1)[live].astype(np.int64) << 32))
+    avail = ((h["hv_lo"].reshape(-1)[live].astype(np.int64) & 0xFFFFFFFF)
+             | (h["hv_hi"].reshape(-1)[live].astype(np.int64) << 32))
+    pos_amt[keys] = amt
+    pos_avail[keys] = avail
+    seqc = h["seqc"].reshape(-1)[:S].astype(np.int32)
+    bal = ((h["bal_lo"].reshape(-1)[:A].astype(np.int64) & 0xFFFFFFFF)
+           | (h["bal_hi"].reshape(-1)[:A].astype(np.int64) << 32))
+    return {
+        "slot_oid": planes2slot(h["bo_lo"], h["bo_hi"]),
+        "slot_aid": planes2slot(h["ba"]).astype(np.int32),
+        "slot_price": planes2slot(h["bp"]).astype(np.int32),
+        "slot_size": slot_size,
+        "slot_seq": planes2slot(h["bq"]).astype(np.int32),
+        "slot_used": used,
+        "seq": seqc,
+        "book_exists": h["bex"].reshape(-1)[:S] != 0,
+        "pos_amt": pos_amt,
+        "pos_avail": pos_avail,
+        "bal": bal,
+        "bal_used": h["bal_u"].reshape(-1)[:A] != 0,
+        "err": np.int32(h["err"].reshape(-1)[0]),
+        "metrics": None,  # counters are host-accumulated in SeqSession
+    }
+
+
+def import_canonical(cfg: SeqConfig, canon: dict):
+    """Inverse of export_canonical (numpy -> device plane dict)."""
+    S, N, A, NR = cfg.lanes, cfg.slots, cfg.accounts, cfg.nr
+
+    def slot2planes(v, split=False):
+        full = np.zeros((S, 2, NR * LN), np.int64)
+        full[:, :, :N] = np.asarray(v).reshape(S, 2, N)
+        flat = full.reshape(2 * S * NR, LN)
+        if split:
+            lo = (flat & 0xFFFFFFFF).astype(np.uint32).astype(np.int32)
+            hi = (flat >> 32).astype(np.int32)
+            return lo, hi
+        return flat.astype(np.int32)
+
+    lo, hi = slot2planes(canon["slot_oid"], split=True)
+    used = np.asarray(canon["slot_used"])
+    sizes = np.where(used, np.asarray(canon["slot_size"]), 0)
+
+    def padplane(v, rows):
+        a = np.zeros(rows * LN, np.int32)
+        a[:len(v)] = v
+        return a.reshape(rows, LN)
+
+    pos_amt = np.asarray(canon["pos_amt"]).reshape(-1)
+    pos_avail = np.asarray(canon["pos_avail"]).reshape(-1)
+    live = np.nonzero(pos_amt != 0)[0]
+    if len(live) > cfg.pos_cap // 2:
+        raise ValueError(
+            f"{len(live)} live positions exceed half the hash capacity "
+            f"{cfg.pos_cap} — raise pos_cap")
+    hk = np.zeros(cfg.pos_cap, np.int32)
+    halo = np.zeros(cfg.pos_cap, np.int32)
+    hahi = np.zeros(cfg.pos_cap, np.int32)
+    hvlo = np.zeros(cfg.pos_cap, np.int32)
+    hvhi = np.zeros(cfg.pos_cap, np.int32)
+    capr = cfg.caprows
+    tilemask = capr - 1
+    for k in live:
+        key = np.int32(k + 1)
+        t = (np.int32(np.int64(key) * -1640531527 & 0xFFFFFFFF
+                      - 0x100000000 * ((np.int64(key) * -1640531527
+                                        & 0xFFFFFFFF) >> 31)) >> 7) \
+            & tilemask
+        # match the kernel's hash exactly via int32 wrap
+        t = int((np.int32(np.int64(key) * np.int64(-1640531527)
+                          & 0xFFFFFFFF if False else
+                          np.int64(key) * np.int64(-1640531527))
+                 >> 7) & tilemask) if False else int(t)
+        placed = False
+        for _p in range(capr):
+            base = (int(t) % capr) * LN
+            row = hk[base:base + LN]
+            empt = np.nonzero(row == 0)[0]
+            if len(empt):
+                j = base + empt[0]
+                hk[j] = key
+                halo[j] = np.int32(pos_amt[k] & 0xFFFFFFFF)
+                hahi[j] = np.int32(pos_amt[k] >> 32)
+                hvlo[j] = np.int32(pos_avail[k] & 0xFFFFFFFF)
+                hvhi[j] = np.int32(pos_avail[k] >> 32)
+                placed = True
+                break
+            t = int(t) + 1
+        if not placed:
+            raise ValueError("position hash import overflow")
+
+    bal = np.asarray(canon["bal"]).reshape(-1)
+    return {
+        "bo_lo": jnp.asarray(lo), "bo_hi": jnp.asarray(hi),
+        "ba": jnp.asarray(slot2planes(canon["slot_aid"])),
+        "bp": jnp.asarray(slot2planes(canon["slot_price"])),
+        "bs": jnp.asarray(slot2planes(sizes)),
+        "bq": jnp.asarray(slot2planes(canon["slot_seq"])),
+        "seqc": jnp.asarray(padplane(np.asarray(canon["seq"]), cfg.srows)),
+        "bex": jnp.asarray(padplane(
+            np.asarray(canon["book_exists"]).astype(np.int32), cfg.srows)),
+        "bal_lo": jnp.asarray(padplane(
+            (bal & 0xFFFFFFFF).astype(np.uint32).astype(np.int32),
+            cfg.arows)),
+        "bal_hi": jnp.asarray(padplane((bal >> 32).astype(np.int32),
+                                       cfg.arows)),
+        "bal_u": jnp.asarray(padplane(
+            np.asarray(canon["bal_used"]).astype(np.int32), cfg.arows)),
+        "hk": jnp.asarray(hk.reshape(capr, LN)),
+        "ha_lo": jnp.asarray(halo.reshape(capr, LN)),
+        "ha_hi": jnp.asarray(hahi.reshape(capr, LN)),
+        "hv_lo": jnp.asarray(hvlo.reshape(capr, LN)),
+        "hv_hi": jnp.asarray(hvhi.reshape(capr, LN)),
+        "err": jnp.asarray(padplane(
+            np.array([int(canon.get("err", 0))], np.int32), 1)),
+    }

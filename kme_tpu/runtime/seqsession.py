@@ -1,0 +1,377 @@
+"""SeqSession: host half of the sequential mega-kernel engine.
+
+Unlike LaneSession, there is NO conflict-free scheduler: the kernel
+processes messages strictly sequentially (engine/seq.py), so planning
+reduces to ID ROUTING — dense aid/sid maps, oid -> lane routing for
+cancels, and host-resolved rejects for messages the device cannot act
+on (unknown-oid cancels, negative-sid ADD_SYMBOL, unmapped
+payout/remove) — the same edge semantics as runtime/sequencer.py.
+Barriers (PAYOUT / REMOVE_SYMBOL) are ordinary device messages here
+(act codes 7/8/9), not separate settle calls.
+
+I/O design (the tunnel lesson, round 4): ONE packed (rows, 128) i32
+output plane per kernel call, all calls dispatched before any fetch,
+fetches started concurrently — every np.asarray round trip after the
+first costs a tunnel RTT (~100ms+ through the driver's tunnel).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+import kme_tpu._jaxsetup  # noqa: F401
+
+from kme_tpu import opcodes as op
+from kme_tpu.engine import seq as SQ
+from kme_tpu.runtime import session as _session
+from kme_tpu.runtime.session import LaneEngineError
+from kme_tpu.runtime.sequencer import CapacityError, EnvelopeError
+from kme_tpu.wire import OrderMsg, OutRecord, order_json
+
+# register the seq-specific sticky-error name so LaneEngineError renders
+# it (the code space is shared with the lanes engine's LERR_*)
+_session._LERR_NAMES[SQ.LERR_HASH_FULL] = \
+    "position hash exhausted (pos_cap knob)"
+
+_TRADE_ACTS = {op.BUY: SQ.L_BUY, op.SELL: SQ.L_SELL}
+
+
+class SeqRouter:
+    """Arrival-order ID routing (no conflict analysis). Mirrors the
+    sequencer's id spaces and host-reject edge semantics."""
+
+    def __init__(self, num_lanes: int, num_accounts: int) -> None:
+        self.S = num_lanes
+        self.A = num_accounts
+        self.aid_idx: Dict[int, int] = {}
+        self.sid_lane: Dict[int, int] = {}
+        self.oid_sid: Dict[int, int] = {}
+
+    def _acct(self, aid: int) -> int:
+        idx = self.aid_idx.get(aid)
+        if idx is None:
+            if len(self.aid_idx) >= self.A:
+                raise CapacityError(
+                    f"account capacity {self.A} exhausted (aid={aid})")
+            idx = len(self.aid_idx)
+            self.aid_idx[aid] = idx
+        return idx
+
+    def _lane(self, sid: int) -> int:
+        lane = self.sid_lane.get(sid)
+        if lane is None:
+            if len(self.sid_lane) >= self.S:
+                raise CapacityError(
+                    f"symbol capacity {self.S} exhausted (sid={sid})")
+            lane = len(self.sid_lane)
+            self.sid_lane[sid] = lane
+        return lane
+
+    def acct_of_idx(self) -> List[int]:
+        out = [0] * len(self.aid_idx)
+        for aid, idx in self.aid_idx.items():
+            out[idx] = aid
+        return out
+
+    def sid_of_lane(self) -> Dict[int, int]:
+        return {lane: sid for sid, lane in self.sid_lane.items()}
+
+    def route(self, msgs: Sequence[OrderMsg]):
+        """-> (cols dict incl. msg_index, host_reject msg indices)."""
+        from kme_tpu.oracle import javalong as jl
+
+        cols = {k: [] for k in ("msg_index", "act", "aid", "price",
+                                "size", "lane", "oid")}
+        host_rejects = set()
+
+        def emit(i, act, aidx, lane, m, oid):
+            cols["msg_index"].append(i)
+            cols["act"].append(act)
+            cols["aid"].append(aidx)
+            cols["price"].append(m.price)
+            cols["size"].append(m.size)
+            cols["lane"].append(lane)
+            cols["oid"].append(oid)
+
+        for i, m in enumerate(msgs):
+            a = m.action
+            if not (-2**31 <= m.price < 2**31 and -2**31 <= m.size < 2**31):
+                raise EnvelopeError(
+                    f"message {i}: price/size outside int32 "
+                    f"(price={m.price}, size={m.size})")
+            aid, sid, oid = jl.jlong(m.aid), jl.jlong(m.sid), jl.jlong(m.oid)
+            if a in _TRADE_ACTS:
+                lane = self._lane(sid)
+                self.oid_sid[oid] = sid
+                emit(i, _TRADE_ACTS[a], self._acct(aid), lane, m, oid)
+            elif a == op.CANCEL:
+                rsid = self.oid_sid.get(oid)
+                if rsid is None:
+                    host_rejects.add(i)
+                    continue
+                emit(i, SQ.L_CANCEL, self._acct(aid), self._lane(rsid),
+                     m, oid)
+            elif a == op.CREATE_BALANCE:
+                emit(i, SQ.L_CREATE, self._acct(aid), 0, m, oid)
+            elif a == op.TRANSFER:
+                emit(i, SQ.L_TRANSFER, self._acct(aid), 0, m, oid)
+            elif a == op.ADD_SYMBOL:
+                if sid < 0:
+                    host_rejects.add(i)
+                    continue
+                emit(i, SQ.L_ADD_SYMBOL, 0, self._lane(sid), m, oid)
+            elif a in (op.REMOVE_SYMBOL, op.PAYOUT):
+                s = abs(sid)
+                if s not in self.sid_lane:
+                    host_rejects.add(i)
+                    continue
+                lane = self.sid_lane[s]
+                if a == op.REMOVE_SYMBOL:
+                    act = SQ.L_REMOVE_SYMBOL
+                else:
+                    act = SQ.L_PAYOUT_YES if sid >= 0 else SQ.L_PAYOUT_NO
+                emit(i, act, 0, lane, m, oid)
+                dead = [o for o, s2 in self.oid_sid.items() if s2 == s]
+                for o in dead:
+                    del self.oid_sid[o]
+            else:
+                host_rejects.add(i)
+        out = {
+            "msg_index": np.array(cols["msg_index"], np.int64),
+            "act": np.array(cols["act"], np.int32),
+            "aid": np.array(cols["aid"], np.int32),
+            "price": np.array(cols["price"], np.int32),
+            "size": np.array(cols["size"], np.int32),
+            "lane": np.array(cols["lane"], np.int32),
+            "oid": np.array(cols["oid"], np.int64),
+        }
+        return out, host_rejects
+
+
+class SeqSession:
+    """Drop-in fixed-mode engine over the sequential mega-kernel.
+
+    Same public surface as LaneSession (process / process_wire /
+    metrics / export_state); single-device (the sharded path stays on
+    the lanes engine)."""
+
+    def __init__(self, cfg: SQ.SeqConfig) -> None:
+        self.cfg = cfg
+        self.state = SQ.make_seq_state(cfg)
+        self.router = SeqRouter(cfg.lanes, cfg.accounts)
+        self._step = SQ.build_seq_step(cfg)
+        self._metrics = np.zeros(SQ.N_METRICS, np.int64)
+
+    # ------------------------------------------------------------------
+
+    def _run(self, msgs: Sequence[OrderMsg]):
+        """Route + dispatch every chunk, then fetch once. Returns
+        (cols, host_rejects, per-device-msg host dict, fills (4, F))."""
+        from kme_tpu.utils import async_prefetch
+
+        cols, host_rejects = self.router.route(msgs)
+        n = len(cols["act"])
+        B = self.cfg.batch
+        planes = []
+        for lo in range(0, max(n, 1), B):
+            cnt = min(B, n - lo) if n else 0
+            chunk = {k: cols[k][lo:lo + cnt] for k in
+                     ("act", "aid", "price", "size", "lane", "oid")}
+            packed = SQ.pack_msgs(self.cfg, chunk, cnt)
+            self.state, outp = self._step(self.state, packed)
+            planes.append((outp, cnt))
+        async_prefetch([p for p, _ in planes])
+        host = {k: [] for k in ("ok", "cap_reject", "append", "residual",
+                                "nfill", "prev_oid")}
+        fills = []
+        mets = np.zeros(SQ.N_METRICS, np.int64)
+        for outp, cnt in planes:
+            res = SQ.unpack_out(self.cfg, np.asarray(outp), cnt)
+            if res["err"] != SQ.LERR_OK:
+                raise LaneEngineError(res["err"])
+            for k in host:
+                host[k].append(res[k])
+            fills.append(res["fills"])
+            mets += res["metrics"]
+        self._metrics += mets
+        host = {k: np.concatenate(v) if v else np.zeros(0)
+                for k, v in host.items()}
+        fills = (np.concatenate(fills, axis=1) if fills
+                 else np.zeros((4, 0), np.int64))
+        return cols, host_rejects, host, fills
+
+    # ------------------------------------------------------------------
+
+    def process_wire(self, msgs: Sequence[OrderMsg]) -> List[List[str]]:
+        cols, host_rejects, host, fills = self._run(msgs)
+        idx_to_aid = self.router.acct_of_idx()
+        lane_to_sid = self.router.sid_of_lane()
+
+        nmsg = len(msgs)
+        ok_of = [False] * nmsg
+        nfill_of = [0] * nmsg
+        off_of = [0] * nmsg
+        resid_of = [0] * nmsg
+        prev_of = [0] * nmsg
+        append_of = [False] * nmsg
+        act_of = [0] * nmsg
+        lane_of = [0] * nmsg
+        mis = cols["msg_index"].tolist()
+        offs = (np.cumsum(host["nfill"]) - host["nfill"]).tolist() \
+            if len(mis) else []
+        for arr, dst in ((host["ok"], ok_of), (host["nfill"], nfill_of),
+                         (host["residual"], resid_of),
+                         (host["prev_oid"], prev_of),
+                         (host["append"], append_of)):
+            vals = arr.tolist()
+            for k, mi in enumerate(mis):
+                dst[mi] = vals[k]
+        acts = cols["act"].tolist()
+        lanes_l = cols["lane"].tolist()
+        for k, mi in enumerate(mis):
+            off_of[mi] = offs[k]
+            act_of[mi] = acts[k]
+            lane_of[mi] = lanes_l[k]
+        f_oid, f_aid, f_price, f_size = (fills[c].tolist() for c in range(4))
+
+        out: List[List[str]] = []
+        for i, m in enumerate(msgs):
+            in_body = order_json(m.action, m.oid, m.aid, m.sid, m.price,
+                                 m.size, m.next, m.prev)
+            lines = [f'IN {in_body}']
+            if i in host_rejects or not ok_of[i]:
+                lines.append('OUT ' + order_json(
+                    op.REJECT, m.oid, m.aid, m.sid, m.price, m.size,
+                    m.next, m.prev))
+            else:
+                lane_act = act_of[i]
+                is_trade = lane_act in (SQ.L_BUY, SQ.L_SELL)
+                if is_trade:
+                    sid = lane_to_sid[lane_of[i]]
+                    is_buy = lane_act == SQ.L_BUY
+                    mk_act = op.SOLD if is_buy else op.BOUGHT
+                    tk_act = op.BOUGHT if is_buy else op.SOLD
+                    o0 = off_of[i]
+                    for e in range(nfill_of[i]):
+                        moid = f_oid[o0 + e]
+                        maid = idx_to_aid[f_aid[o0 + e]]
+                        mprice = f_price[o0 + e]
+                        fsz = f_size[o0 + e]
+                        lines.append('OUT ' + order_json(
+                            mk_act, moid, maid, sid, 0, fsz))
+                        lines.append('OUT ' + order_json(
+                            tk_act, m.oid, m.aid, sid, m.price - mprice,
+                            fsz))
+                    lines.append('OUT ' + order_json(
+                        m.action, m.oid, m.aid, m.sid, m.price,
+                        resid_of[i], m.next,
+                        int(prev_of[i]) if append_of[i] else m.prev))
+                else:
+                    lines.append(f'OUT {in_body}')
+            out.append(lines)
+        return out
+
+    def process(self, msgs: Sequence[OrderMsg]) -> List[List[OutRecord]]:
+        cols, host_rejects, host, fills = self._run(msgs)
+        idx_to_aid = self.router.acct_of_idx()
+        lane_to_sid = self.router.sid_of_lane()
+        nmsg = len(msgs)
+        dev = {}
+        offs = np.cumsum(host["nfill"]) - host["nfill"] \
+            if len(cols["msg_index"]) else np.zeros(0)
+        for k, mi in enumerate(cols["msg_index"].tolist()):
+            dev[mi] = k
+
+        out: List[List[OutRecord]] = []
+        for i, m in enumerate(msgs):
+            recs = [OutRecord("IN", m.copy())]
+            if i in host_rejects:
+                echo = m.copy()
+                echo.action = op.REJECT
+                recs.append(OutRecord("OUT", echo))
+            else:
+                k = dev[i]
+                ok = bool(host["ok"][k])
+                lane_act = int(cols["act"][k])
+                is_trade = lane_act in (SQ.L_BUY, SQ.L_SELL)
+                if is_trade and ok:
+                    sid = lane_to_sid[int(cols["lane"][k])]
+                    is_buy = lane_act == SQ.L_BUY
+                    o0 = int(offs[k])
+                    for e in range(int(host["nfill"][k])):
+                        moid = int(fills[0, o0 + e])
+                        maid = idx_to_aid[int(fills[1, o0 + e])]
+                        mprice = int(fills[2, o0 + e])
+                        fsz = int(fills[3, o0 + e])
+                        recs.append(OutRecord("OUT", OrderMsg(
+                            action=op.SOLD if is_buy else op.BOUGHT,
+                            oid=moid, aid=maid, sid=sid, price=0, size=fsz)))
+                        recs.append(OutRecord("OUT", OrderMsg(
+                            action=op.BOUGHT if is_buy else op.SOLD,
+                            oid=m.oid, aid=m.aid, sid=sid,
+                            price=m.price - mprice, size=fsz)))
+                echo = m.copy()
+                if not ok:
+                    echo.action = op.REJECT
+                if is_trade and ok:
+                    echo.size = int(host["residual"][k])
+                    if bool(host["append"][k]):
+                        echo.prev = int(host["prev_oid"][k])
+                recs.append(OutRecord("OUT", echo))
+            out.append(recs)
+        return out
+
+    # ------------------------------------------------------------------
+
+    def metrics(self) -> Dict[str, int]:
+        counters = dict(zip(SQ.METRIC_NAMES, self._metrics.tolist()))
+        canon = SQ.export_canonical(self.cfg, self.state)
+        used = canon["slot_used"]
+        depth = used.sum(axis=2)
+        counters.update({
+            "open_orders": int(used.sum()),
+            "books": int(canon["book_exists"].sum()),
+            "accounts": int(canon["bal_used"].sum()),
+            "positions": int((canon["pos_amt"] != 0).sum()),
+            "max_book_depth": int(depth.max()) if depth.size else 0,
+        })
+        return counters
+
+    def export_state(self) -> Dict[str, dict]:
+        """Oracle-comparable host dict view (fixed mode)."""
+        canon = SQ.export_canonical(self.cfg, self.state)
+        idx_to_aid = self.router.acct_of_idx()
+        lane_to_sid = self.router.sid_of_lane()
+        A = self.cfg.accounts
+        balances = {idx_to_aid[i]: int(canon["bal"][i])
+                    for i in range(len(idx_to_aid)) if canon["bal_used"][i]}
+        positions = {}
+        pos_amt = canon["pos_amt"].reshape(self.cfg.lanes, A)
+        pos_avail = canon["pos_avail"].reshape(self.cfg.lanes, A)
+        orders = {}
+        S, _, N = canon["slot_oid"].shape
+        for lane in range(S):
+            sid = lane_to_sid.get(lane)
+            if sid is None:
+                continue
+            for a in range(len(idx_to_aid)):
+                if pos_amt[lane, a] != 0:
+                    positions[(idx_to_aid[a], sid)] = (
+                        int(pos_amt[lane, a]), int(pos_avail[lane, a]))
+            for side in range(2):
+                for nn in range(N):
+                    if canon["slot_used"][lane, side, nn]:
+                        orders[int(canon["slot_oid"][lane, side, nn])] = {
+                            "aid": idx_to_aid[int(
+                                canon["slot_aid"][lane, side, nn])],
+                            "sid": sid,
+                            "price": int(canon["slot_price"][lane, side, nn]),
+                            "size": int(canon["slot_size"][lane, side, nn]),
+                            "is_buy": side == 0,
+                        }
+        books = {sid: True for sid, lane in self.router.sid_lane.items()
+                 if canon["book_exists"][lane]}
+        return {"balances": balances, "positions": positions,
+                "orders": orders, "books": books}

@@ -1,0 +1,210 @@
+"""Sequential mega-kernel engine vs the oracle's fixed-mode semantics.
+
+The seq engine claims bit-exact serial replay by construction
+(kme_tpu/engine/seq.py): the kernel processes messages in arrival
+order, so its wire stream and store state must equal the scalar
+oracle's under the same capacity envelope. On CPU the kernel runs
+under pallas interpret mode — the same kernel logic, not a shadow
+implementation.
+"""
+
+import numpy as np
+import pytest
+
+import kme_tpu.opcodes as op
+from kme_tpu.engine import seq as SQ
+from kme_tpu.oracle import OracleEngine
+from kme_tpu.runtime.seqsession import SeqSession
+from kme_tpu.wire import OrderMsg
+from kme_tpu.workload import harness_stream, zipf_symbol_stream
+
+CFG = SQ.SeqConfig(lanes=8, slots=128, accounts=128, max_fills=32,
+                   batch=128, pos_cap=1 << 11, fill_cap=1 << 12,
+                   probe_max=16)
+
+
+def assert_seq_parity(msgs, cfg=CFG):
+    ses = SeqSession(cfg)
+    wire_ses = SeqSession(cfg)
+    ora = OracleEngine("fixed", book_slots=cfg.slots,
+                      max_fills=cfg.max_fills)
+    got = ses.process(msgs)
+    got_wire = wire_ses.process_wire([m.copy() for m in msgs])
+    for i, m in enumerate(msgs):
+        want = [r.wire() for r in ora.process(m.copy())]
+        g = [r.wire() for r in got[i]]
+        assert g == want, f"stream diverged at message {i}: {m}\n" \
+            f"got  {g}\nwant {want}"
+        assert got_wire[i] == want, \
+            f"wire path diverged at message {i}: {m}"
+    exp = ses.export_state()
+    assert exp["balances"] == dict(ora.balances)
+    assert exp["positions"] == dict(ora.positions)
+    oorders = {oid: {"aid": r.aid, "sid": r.sid, "price": r.price,
+                     "size": r.size, "is_buy": r.action == op.BUY}
+               for oid, r in ora.orders.items()}
+    assert exp["orders"] == oorders
+    # fixed-mode oracle book keys are 2*sid (buy) / 2*sid+1 (sell)
+    assert set(exp["books"]) == {k // 2 for k in ora.books}
+    return ses, ora
+
+
+def test_seq_scenario_end_to_end():
+    """The lanes engine's scenario stream: every opcode incl. barriers,
+    double cancel, unknown oid, payout YES/NO, remove + re-add."""
+    msgs = []
+    for a in range(4):
+        msgs.append(OrderMsg(action=op.CREATE_BALANCE, aid=a))
+        msgs.append(OrderMsg(action=op.TRANSFER, aid=a, size=100000))
+    for s in (0, 1, 2):
+        msgs.append(OrderMsg(action=op.ADD_SYMBOL, sid=s))
+    msgs += [
+        OrderMsg(action=op.BUY, oid=10, aid=0, sid=0, price=40, size=5),
+        OrderMsg(action=op.BUY, oid=11, aid=1, sid=0, price=40, size=3),
+        OrderMsg(action=op.SELL, oid=12, aid=2, sid=0, price=35, size=6),
+        OrderMsg(action=op.SELL, oid=13, aid=3, sid=1, price=60, size=4),
+        OrderMsg(action=op.BUY, oid=14, aid=0, sid=1, price=65, size=2),
+        OrderMsg(action=op.CANCEL, oid=13, aid=3),
+        OrderMsg(action=op.CANCEL, oid=13, aid=3),
+        OrderMsg(action=op.CANCEL, oid=999, aid=0),
+        OrderMsg(action=op.BUY, oid=15, aid=1, sid=2, price=50, size=4),
+        OrderMsg(action=op.BUY, oid=16, aid=2, sid=2, price=50, size=2),
+        OrderMsg(action=op.SELL, oid=17, aid=3, sid=2, price=45, size=9),
+        OrderMsg(action=op.PAYOUT, sid=2, size=97),
+        OrderMsg(action=op.PAYOUT, sid=-1, size=97),
+        OrderMsg(action=op.REMOVE_SYMBOL, sid=0),
+        OrderMsg(action=op.ADD_SYMBOL, sid=0),
+        OrderMsg(action=op.BUY, oid=18, aid=0, sid=0, price=30, size=1),
+        OrderMsg(action=op.ADD_SYMBOL, sid=-3),
+        OrderMsg(action=op.TRANSFER, aid=9, size=5),
+        OrderMsg(action=99, oid=0, aid=0),
+    ]
+    assert_seq_parity(msgs)
+
+
+def test_seq_same_account_same_symbol_runs():
+    """The workload shape the lanes scheduler serializes (H1): one
+    account hammering one symbol back-to-back — the seq kernel has no
+    scheduling constraints, but must still be byte-exact."""
+    msgs = [OrderMsg(action=op.CREATE_BALANCE, aid=1),
+            OrderMsg(action=op.TRANSFER, aid=1, size=10**6),
+            OrderMsg(action=op.CREATE_BALANCE, aid=2),
+            OrderMsg(action=op.TRANSFER, aid=2, size=10**6),
+            OrderMsg(action=op.ADD_SYMBOL, sid=5)]
+    oid = 100
+    for k in range(40):
+        msgs.append(OrderMsg(action=op.BUY, oid=oid, aid=1, sid=5,
+                             price=40 + (k % 7), size=1 + (k % 5)))
+        oid += 1
+        msgs.append(OrderMsg(action=op.SELL, oid=oid, aid=2, sid=5,
+                             price=38 + (k % 9), size=1 + (k % 4)))
+        oid += 1
+        if k % 3 == 0:
+            msgs.append(OrderMsg(action=op.CANCEL, oid=oid - 2, aid=1))
+    assert_seq_parity(msgs)
+
+
+def test_seq_max_fills_envelope_reject():
+    cfg = SQ.SeqConfig(lanes=8, slots=128, accounts=128, max_fills=2,
+                       batch=128, pos_cap=1 << 11, fill_cap=1 << 12,
+                       probe_max=16)
+    msgs = [OrderMsg(action=op.CREATE_BALANCE, aid=1),
+            OrderMsg(action=op.TRANSFER, aid=1, size=10**6),
+            OrderMsg(action=op.CREATE_BALANCE, aid=2),
+            OrderMsg(action=op.TRANSFER, aid=2, size=10**6),
+            OrderMsg(action=op.ADD_SYMBOL, sid=1)]
+    for k in range(3):
+        msgs.append(OrderMsg(action=op.SELL, oid=10 + k, aid=1, sid=1,
+                             price=50, size=2))
+    # sweeps 3 makers -> capacity REJECT; then a 2-maker sweep passes
+    msgs.append(OrderMsg(action=op.BUY, oid=20, aid=2, sid=1,
+                         price=55, size=6))
+    msgs.append(OrderMsg(action=op.BUY, oid=21, aid=2, sid=1,
+                         price=55, size=4))
+    ses, _ = assert_seq_parity(msgs, cfg)
+    m = ses.metrics()
+    assert m["rej_capacity"] == 1
+    assert m["trades_ok"] == 4  # 3 resting sells + the 2-maker buy
+
+
+def test_seq_book_slots_envelope_reject():
+    msgs = [OrderMsg(action=op.CREATE_BALANCE, aid=1),
+            OrderMsg(action=op.TRANSFER, aid=1, size=10**8),
+            OrderMsg(action=op.ADD_SYMBOL, sid=1)]
+    for k in range(CFG.slots + 1):   # the last one overflows the side
+        msgs.append(OrderMsg(action=op.BUY, oid=100 + k, aid=1, sid=1,
+                             price=1 + (k % 30), size=1))
+    ses, _ = assert_seq_parity(msgs)
+    assert ses.metrics()["rej_capacity"] == 1
+
+
+def test_seq_harness_stream_parity():
+    """Stock harness distribution (10 accounts, 3 symbols) — the exact
+    shape H1 penalizes on the lanes engine."""
+    msgs = harness_stream(600, seed=7)
+    assert_seq_parity(msgs, SQ.SeqConfig(
+        lanes=8, slots=128, accounts=128, max_fills=64, batch=256,
+        pos_cap=1 << 11, fill_cap=1 << 13, probe_max=16))
+
+
+def test_seq_zipf_stream_parity():
+    msgs = zipf_symbol_stream(500, num_symbols=6, num_accounts=24, seed=3)
+    assert_seq_parity(msgs, SQ.SeqConfig(
+        lanes=8, slots=128, accounts=128, max_fills=64, batch=256,
+        pos_cap=1 << 11, fill_cap=1 << 13, probe_max=16))
+
+
+def test_seq_canonical_roundtrip_and_resume():
+    """Export -> import mid-stream must continue byte-exact (the
+    cross-engine snapshot contract)."""
+    msgs = zipf_symbol_stream(400, num_symbols=5, num_accounts=16, seed=11)
+    cut = 250
+    cfg = SQ.SeqConfig(lanes=8, slots=128, accounts=128, max_fills=64,
+                       batch=128, pos_cap=1 << 11, fill_cap=1 << 13,
+                       probe_max=16)
+    full = SeqSession(cfg)
+    want = full.process_wire([m.copy() for m in msgs])
+
+    a = SeqSession(cfg)
+    got_head = a.process_wire([m.copy() for m in msgs[:cut]])
+    canon = SQ.export_canonical(cfg, a.state)
+    b = SeqSession(cfg)
+    b.state = SQ.import_canonical(cfg, canon)
+    b.router = a.router
+    got_tail = b.process_wire([m.copy() for m in msgs[cut:]])
+    assert got_head + got_tail == want
+
+
+def test_seq_hash_full_error():
+    cfg = SQ.SeqConfig(lanes=8, slots=128, accounts=128, max_fills=8,
+                       batch=128, pos_cap=128, fill_cap=1 << 12,
+                       probe_max=1)
+    msgs = [OrderMsg(action=op.CREATE_BALANCE, aid=0),
+            OrderMsg(action=op.TRANSFER, aid=0, size=10**9)]
+    for a in range(1, 100):
+        msgs.append(OrderMsg(action=op.CREATE_BALANCE, aid=a))
+        msgs.append(OrderMsg(action=op.TRANSFER, aid=a, size=10**9))
+    for s in range(8):
+        msgs.append(OrderMsg(action=op.ADD_SYMBOL, sid=s))
+    oid = 1000
+    # >128 distinct (lane, account) positions at probe_max=1 must trip
+    # the sticky HASH_FULL error eventually
+    from kme_tpu.runtime.session import LaneEngineError
+    ses = SeqSession(cfg)
+    try:
+        for s in range(8):
+            batch = []
+            for a in range(32):
+                batch.append(OrderMsg(action=op.SELL, oid=oid, aid=a % 99,
+                                      sid=s, price=50, size=1))
+                oid += 1
+                batch.append(OrderMsg(action=op.BUY, oid=oid,
+                                      aid=(a + 1) % 99, sid=s, price=55,
+                                      size=1))
+                oid += 1
+            ses.process_wire(msgs + batch if s == 0 else batch)
+        raised = False
+    except LaneEngineError as e:
+        raised = True
+        assert e.code == SQ.LERR_HASH_FULL
+    assert raised
