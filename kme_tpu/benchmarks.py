@@ -34,17 +34,135 @@ DEFAULT_WIDTH = 4
 DEFAULT_LATENCY_BATCH = 2048
 
 
+def _judge_wire(msgs, prefix: int, kw: dict):
+    """The quirk-exact judge's wire stream for a message prefix: the
+    native C++ replica when available (itself pinned byte+store-exact
+    against the Python oracle by tests/test_native_oracle.py), else the
+    Python oracle. A native-engine failure must SURFACE, not silently
+    fall back — the judge's health is part of what the check verifies."""
+    use_native = False
+    try:
+        from kme_tpu.native.oracle import NativeOracleEngine, native_available
+
+        use_native = native_available()
+    except ImportError:
+        pass
+    if use_native:
+        judge = NativeOracleEngine("fixed", **kw)
+        return judge.process_wire([m.copy() for m in msgs[:prefix]])
+    from kme_tpu.oracle import OracleEngine
+
+    print("bench: native judge unavailable; using the Python oracle",
+          file=sys.stderr)
+    ora = OracleEngine("fixed", **kw)
+    return [[r.wire() for r in ora.process(msgs[i].copy())]
+            for i in range(prefix)]
+
+
 def _assert_parity_prefix(msgs, cfg, shards, prefix: int,
                           width: int) -> None:
     """Replay `prefix` messages through a throwaway session and the
     quirk-exact reference replica (with the matching capacity envelope);
-    require byte-identical wire streams. Uses the native C++ replica
-    when available (itself pinned byte+store-exact against the Python
-    oracle by tests/test_native_oracle.py); falls back to the Python
-    oracle otherwise."""
+    require byte-identical wire streams."""
     from kme_tpu.runtime.session import LaneSession
 
     ses = LaneSession(cfg, shards=shards, width=width)
+    want = _judge_wire(msgs, prefix,
+                       dict(book_slots=cfg.slots, max_fills=cfg.max_fills))
+    got = ses.process_wire(msgs[:prefix])
+    for i in range(prefix):
+        assert got[i] == want[i], \
+            f"bench parity prefix diverged at message {i}"
+
+
+def bench_seq_engine(events: int = 100_000, symbols: int = 1024,
+                     accounts: int = 2048, seed: int = 0,
+                     zipf_a: float = 1.2, slots: int = 128,
+                     max_fills: int = 16, batch: int = 4096,
+                     parity_prefix: int = 20000,
+                     workload: str = "zipf") -> dict:
+    """End-to-end throughput of the SEQUENTIAL MEGA-KERNEL engine
+    (kme_tpu/engine/seq.py) on the headline row: route + one scan
+    dispatch + one-round fetch + native C++ wire reconstruction, with
+    fill parity vs the quirk-exact replica asserted on a stream prefix
+    in-run. This is the round-4 headline path: the kernel executes the
+    full stream serially on-device (no scheduling constraints), so
+    account- or symbol-skewed streams run at full speed."""
+    import jax
+
+    from kme_tpu.engine import seq as SQ
+    from kme_tpu.runtime.seqsession import SeqSession
+    from kme_tpu.workload import cancel_heavy_stream, zipf_symbol_stream
+
+    cfg = SQ.SeqConfig(lanes=symbols, slots=slots, accounts=accounts,
+                       max_fills=max_fills, batch=batch)
+    if workload == "cancel":
+        msgs = cancel_heavy_stream(events, num_symbols=symbols,
+                                   num_accounts=accounts, seed=seed)
+    else:
+        msgs = zipf_symbol_stream(events, num_symbols=symbols,
+                                  num_accounts=accounts, seed=seed,
+                                  zipf_a=zipf_a)
+    preamble = 2 * accounts + symbols
+    prefix = min(preamble + parity_prefix, len(msgs))
+    _assert_seq_parity_prefix(msgs, cfg, prefix)
+
+    warm = SeqSession(cfg)          # warmup: compile + shapes
+    if warm.process_wire_buffer(msgs) is None:
+        warm.process_wire(msgs)     # no native toolchain: warm this path
+    ses = SeqSession(cfg)
+    t0 = time.perf_counter()
+    r = ses.process_wire_buffer(msgs)
+    total = time.perf_counter() - t0
+    if r is None:  # no native toolchain: pure-Python reconstruction
+        t0 = time.perf_counter()
+        records = ses.process_wire(msgs)
+        total = time.perf_counter() - t0
+        n_records = sum(len(x) for x in records)
+    else:
+        _buf, line_off, _ml = r
+        n_records = len(line_off) - 1
+    n = len(msgs)
+    ph = dict(ses.phases)
+    metrics = ses.metrics()
+    ops = n / total
+    return {
+        "metric": "orders_per_sec_e2e",
+        "value": round(ops, 1),
+        "unit": "orders/s",
+        "vs_baseline": round(ops / REFERENCE_BASELINE_OPS, 3),
+        "detail": {
+            "engine": "seq (sequential Pallas mega-kernel)",
+            "events": n, "symbols": symbols, "accounts": accounts,
+            "workload": workload, "zipf_a": zipf_a, "slots": slots,
+            "max_fills": max_fills, "batch": batch,
+            "plan_s": round(ph.get("plan_s", 0.0), 3),
+            "dispatch_s": round(ph.get("dispatch_s", 0.0), 3),
+            "fetch_s": round(ph.get("fetch_s", 0.0), 3),
+            "recon_s": round(ph.get("recon_s", 0.0), 3),
+            "total_s": round(total, 3),
+            # dispatch = input transfer + the whole device scan; the
+            # kernel itself measures ~0.06us/msg in a transfer-free
+            # process (16M msgs/s device-path)
+            "device_orders_per_sec": round(
+                n / max(ph.get("dispatch_s", 1e-9), 1e-9), 1),
+            "out_records": n_records,
+            "cap_rejects": int(metrics.get("rej_capacity", 0)),
+            "parity_checked_msgs": prefix,
+            "backend": jax.devices()[0].platform,
+            "baseline_assumption_ops": REFERENCE_BASELINE_OPS,
+            "device_metrics": metrics,
+        },
+    }
+
+
+def _assert_seq_parity_prefix(msgs, cfg, prefix: int) -> None:
+    """Replay `prefix` messages through a throwaway SeqSession and the
+    quirk-exact replica; require byte-identical wire streams (the same
+    judge discipline as the lanes bench)."""
+    from kme_tpu.runtime.seqsession import SeqSession
+
+    ses = SeqSession(cfg)
     kw = dict(book_slots=cfg.slots, max_fills=cfg.max_fills)
     use_native = False
     try:
@@ -54,8 +172,6 @@ def _assert_parity_prefix(msgs, cfg, shards, prefix: int,
     except ImportError:
         pass
     if use_native:
-        # a native-engine failure here must SURFACE, not silently fall
-        # back — the judge's health is part of what the check verifies
         judge = NativeOracleEngine("fixed", **kw)
         want = judge.process_wire([m.copy() for m in msgs[:prefix]])
     else:
@@ -68,8 +184,7 @@ def _assert_parity_prefix(msgs, cfg, shards, prefix: int,
                 for i in range(prefix)]
     got = ses.process_wire(msgs[:prefix])
     for i in range(prefix):
-        assert got[i] == want[i], \
-            f"bench parity prefix diverged at message {i}"
+        assert got[i] == want[i],             f"seq bench parity prefix diverged at message {i}"
 
 
 def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
@@ -358,6 +473,9 @@ def main(argv=None) -> int:
     p.add_argument("--suite", choices=("lanes", "parity", "native",
                                        "latency"),
                    default="lanes")
+    p.add_argument("--engine", choices=("seq", "sweep"), default="seq",
+                   help="lanes-suite engine: the sequential mega-kernel "
+                        "(default) or the vectorized sweep engine")
     p.add_argument("--events", type=int, default=None)
     p.add_argument("--symbols", type=int, default=1024)
     p.add_argument("--accounts", type=int, default=2048)
@@ -388,7 +506,13 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--compat", choices=("java", "fixed"), default="java")
     args = p.parse_args(argv)
-    if args.suite == "lanes":
+    if args.suite == "lanes" and args.engine == "seq":
+        rec = bench_seq_engine(args.events or 100_000, args.symbols,
+                               args.accounts, args.seed, args.zipf,
+                               slots=args.slots, max_fills=args.max_fills,
+                               parity_prefix=args.parity_prefix,
+                               workload=args.workload)
+    elif args.suite == "lanes":
         rec = bench_lane_engine(args.events or 100_000, args.symbols,
                                 args.accounts, args.seed, args.zipf,
                                 steps=args.steps, slots=args.slots,

@@ -151,8 +151,17 @@ def make_seq_state(cfg: SeqConfig):
 # output plane layout (host unpack in unpack_out)
 
 def out_rows(cfg: SeqConfig):
+    """Output plane rows: [0] scalars (err, fill_total, metric deltas);
+    [1, 1+5BR) per-message regions (flags/residual/nfill/prev lo/hi);
+    [1+5BR, ...) fills in GROUPS of 5 rows per 128 entries (oid lo/hi,
+    aid, price, size) so the used prefix is ONE contiguous row slice —
+    the host fetches header + exactly ceil(fill_total/128) groups."""
     BR, FR = cfg.batch // LN, cfg.fill_cap // LN
-    return 5 * BR + 5 * FR + 1
+    return 1 + 5 * BR + 5 * FR
+
+
+def hdr_rows(cfg: SeqConfig):
+    return 1 + 5 * (cfg.batch // LN)
 
 
 # ---------------------------------------------------------------------------
@@ -449,7 +458,8 @@ def build_seq_step(cfg: SeqConfig):
             put(out, r, m & _i(127), v)
 
         def fill_put(field, p, v):
-            r = _i(5 * BR + field * FR) + (p >> _i(7))
+            # group layout: 5 consecutive rows per 128 fill entries
+            r = _i(1 + 5 * BR) + (p >> _i(7)) * _i(5) + _i(field)
             put(out, r, p & _i(127), v)
 
         # ==============================================================
@@ -798,11 +808,11 @@ def build_seq_step(cfg: SeqConfig):
                                                       act == _i(L_NOP)))))))
             flags = (ok.astype(I32) | (cap_reject.astype(I32) << _i(1))
                      | (append.astype(I32) << _i(2)))
-            out_put(_i(0), m, flags)
-            out_put(_i(BR), m, jnp.where(trade_acc, residual_t, size))
-            out_put(_i(2 * BR), m, jnp.where(trade_acc, nfill, _i(0)))
-            out_put(_i(3 * BR), m, tail_lo)
-            out_put(_i(4 * BR), m, tail_hi)
+            out_put(_i(1), m, flags)
+            out_put(_i(1 + BR), m, jnp.where(trade_acc, residual_t, size))
+            out_put(_i(1 + 2 * BR), m, jnp.where(trade_acc, nfill, _i(0)))
+            out_put(_i(1 + 3 * BR), m, tail_lo)
+            out_put(_i(1 + 4 * BR), m, tail_hi)
 
             filled = jnp.where(trade_acc, size - residual_t, _i(0))
             nf = jnp.where(trade_acc, nfill, _i(0))
@@ -835,11 +845,11 @@ def build_seq_step(cfg: SeqConfig):
         scal = jnp.where(ci == _i(1), fill_total, scal)
         for k in range(N_METRICS):
             scal = jnp.where(ci == _i(2 + k), met[k], scal)
-        out[NROWS - 1:NROWS, :] = scal
+        out[0:1, :] = scal
 
     nstate = len(_STATE_KEYS)
 
-    def call(state, msgs):
+    def raw_call(state, msgs):
         outs = pl.pallas_call(
             kernel,
             out_shape=tuple(
@@ -863,7 +873,26 @@ def build_seq_step(cfg: SeqConfig):
     # the aliased outputs read zeros — observed under interpret); the
     # aliasing alone keeps the in-kernel copy semantics, at the cost of
     # one XLA copy of the state per call (~10MB, ~12us on v5e).
-    return jax.jit(call)
+    return jax.jit(raw_call), raw_call
+
+
+@functools.lru_cache(maxsize=None)
+def build_seq_scan(cfg: SeqConfig, k: int):
+    """ONE jitted dispatch for k chunks: lax.scan threads the state
+    through k kernel invocations and stacks the k output planes on
+    device. On the tunneled driver every separate dispatch/fetch costs
+    ~a round trip (~100-150ms blocked), so a 100k-message stream runs
+    as one scan call + two sliced fetches instead of ~26 of each."""
+    _, raw_call = build_seq_step(cfg)
+
+    def call_scan(state, stacked):
+        def body(st, ms):
+            st2, outp = raw_call(st, ms)
+            return st2, outp
+
+        return jax.lax.scan(body, state, stacked, length=k)
+
+    return jax.jit(call_scan)
 
 
 # ---------------------------------------------------------------------------
@@ -885,37 +914,52 @@ def pack_msgs(cfg: SeqConfig, cols: dict, n: int) -> dict:
     return out
 
 
-def unpack_out(cfg: SeqConfig, plane: np.ndarray, n: int) -> dict:
-    """(out_rows, 128) i32 -> host dict for reconstruction."""
-    B, FB = cfg.batch, cfg.fill_cap
-    BR, FR = B // LN, FB // LN
-    flat = plane.reshape(-1)
-    flags = flat[:B][:n]
+def unpack_hdr(cfg: SeqConfig, hdr: np.ndarray, n: int) -> dict:
+    """Header slice (hdr_rows, 128) -> per-message host dict + scalars."""
+    B = cfg.batch
+    BR = B // LN
+    flat = hdr.reshape(-1)
+    scal = flat[:LN]
+    base = LN
+    flags = flat[base:base + B][:n]
     res = {
         "ok": (flags & 1) != 0,
         "cap_reject": (flags & 2) != 0,
         "append": (flags & 4) != 0,
-        "residual": flat[BR * LN:BR * LN + B][:n],
-        "nfill": flat[2 * BR * LN:2 * BR * LN + B][:n],
-        "prev_oid": ((flat[3 * BR * LN:3 * BR * LN + B][:n].astype(np.int64)
-                      & 0xFFFFFFFF)
-                     | (flat[4 * BR * LN:4 * BR * LN + B][:n]
+        "residual": flat[base + BR * LN:base + BR * LN + B][:n],
+        "nfill": flat[base + 2 * BR * LN:base + 2 * BR * LN + B][:n],
+        "prev_oid": ((flat[base + 3 * BR * LN:base + 3 * BR * LN + B][:n]
+                      .astype(np.int64) & 0xFFFFFFFF)
+                     | (flat[base + 4 * BR * LN:base + 4 * BR * LN + B][:n]
                         .astype(np.int64) << 32)),
+        "err": int(scal[0]),
+        "fill_total": int(scal[1]),
+        "metrics": scal[2:2 + N_METRICS].astype(np.int64),
     }
-    fbase = 5 * BR * LN
-    fills = flat[fbase:fbase + 5 * FB].reshape(5, FB)
-    scal = flat[-LN:]
-    err, ftot = int(scal[0]), int(scal[1])
-    res["err"] = err
-    res["fill_total"] = ftot
-    res["metrics"] = scal[2:2 + N_METRICS].astype(np.int64)
-    f_oid = ((fills[0, :ftot].astype(np.int64) & 0xFFFFFFFF)
-             | (fills[1, :ftot].astype(np.int64) << 32))
-    res["fills"] = np.stack([
-        f_oid,
-        fills[2, :ftot].astype(np.int64),
-        fills[3, :ftot].astype(np.int64),
-        fills[4, :ftot].astype(np.int64)])
+    return res
+
+
+def unpack_fills(groups: np.ndarray, ftot: int) -> np.ndarray:
+    """Fill group rows (5g, 128) -> (4, ftot) [oid, aid, price, size]."""
+    if ftot == 0:
+        return np.zeros((4, 0), np.int64)
+    g = groups.reshape(-1, 5, LN)
+    per = np.transpose(g, (1, 0, 2)).reshape(5, -1)
+    f_oid = ((per[0, :ftot].astype(np.int64) & 0xFFFFFFFF)
+             | (per[1, :ftot].astype(np.int64) << 32))
+    return np.stack([f_oid,
+                     per[2, :ftot].astype(np.int64),
+                     per[3, :ftot].astype(np.int64),
+                     per[4, :ftot].astype(np.int64)])
+
+
+def unpack_out(cfg: SeqConfig, plane: np.ndarray, n: int) -> dict:
+    """Whole-plane unpack (tests / single-shot paths)."""
+    HR = hdr_rows(cfg)
+    res = unpack_hdr(cfg, plane[:HR], n)
+    ftot = res["fill_total"]
+    groups = plane[HR:HR + 5 * (-(-max(ftot, 1) // LN))]
+    res["fills"] = unpack_fills(groups, ftot)
     return res
 
 

@@ -19,7 +19,8 @@ from typing import Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRCS = (os.path.join(_HERE, "kme_host.cpp"),
-         os.path.join(_HERE, "kme_oracle.cpp"))
+         os.path.join(_HERE, "kme_oracle.cpp"),
+         os.path.join(_HERE, "kme_wire.cpp"))
 
 _lib = None
 _lib_tried = False
@@ -145,6 +146,21 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "kme_oracle_n_processed": ([c.c_void_p], c.c_int64),
         "kme_oracle_dump_state": ([c.c_void_p], c.c_char_p),
         "kme_oracle_load_state": ([c.c_void_p, c.c_char_p], c.c_int32),
+        # native wire reconstruction (kme_wire.cpp)
+        "kme_recon_new": ([], c.c_void_p),
+        "kme_recon_free": ([c.c_void_p], None),
+        "kme_recon_buf": ([c.c_void_p], c.c_void_p),
+        "kme_recon_len": ([c.c_void_p], c.c_int64),
+        "kme_recon_n_lines": ([c.c_void_p], c.c_int64),
+        "kme_recon_line_off": ([c.c_void_p], P64),
+        "kme_recon_msg_lines": ([c.c_void_p], P32),
+        "kme_recon_wire": ([c.c_int64] + [P64] * 6
+                           + [P64, c.POINTER(c.c_uint8)] * 2
+                           + [c.POINTER(c.c_uint8), P32,
+                              c.POINTER(c.c_uint8), P32, P64, P64, P64,
+                              c.POINTER(c.c_uint8), P64]
+                           + [c.c_int64] + [P64] * 4 + [c.c_void_p],
+                           c.c_int32),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)

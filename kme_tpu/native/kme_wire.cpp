@@ -1,0 +1,182 @@
+// Native wire-stream reconstruction for the sequential engine.
+//
+// The engine returns compact per-message arrays + a packed fill log;
+// turning those into the byte-exact `IN {...}` / `OUT {...}` record
+// stream (consumer.js:19 format; Jackson template wire.order_json) was
+// a per-fill Python loop costing ~1s per 100k messages — the host-side
+// cap SURVEY.md §7 H5 warns about. This is the same reconstruction in
+// C++ behind a C ABI: one call emits every line into a single buffer
+// with per-line offsets; Python slices lazily or streams the buffer.
+// Semantics authority: SeqSession.process_wire (runtime/seqsession.py);
+// equivalence is pinned by tests/test_seq_engine.py.
+//
+// Built together with kme_host.cpp / kme_oracle.cpp by
+// kme_tpu/native/__init__.py.
+
+#include <charconv>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int32_t L_BUY = 1, L_SELL = 2;
+constexpr int64_t OP_BOUGHT = 5, OP_SOLD = 6, OP_REJECT = 7;
+
+struct Recon {
+  // output storage (valid until the next call / free)
+  char* buf = nullptr;
+  int64_t cap = 0, len = 0;
+  int64_t* line_off = nullptr;   // start offset of each line
+  int64_t n_lines = 0, lines_cap = 0;
+  int32_t* msg_lines = nullptr;  // lines per message
+  int64_t nmsg_cap = 0;
+  ~Recon() {
+    delete[] buf;
+    delete[] line_off;
+    delete[] msg_lines;
+  }
+};
+
+inline void put_raw(Recon& r, const char* s, int64_t n) {
+  std::memcpy(r.buf + r.len, s, n);
+  r.len += n;
+}
+
+inline void put_i64(Recon& r, int64_t v) {
+  auto res = std::to_chars(r.buf + r.len, r.buf + r.cap, v);
+  r.len = res.ptr - r.buf;
+}
+
+// order_json (wire.py): compact Jackson template, declaration order.
+inline void put_order(Recon& r, int64_t action, int64_t oid, int64_t aid,
+                      int64_t sid, int64_t price, int64_t size,
+                      bool has_next, int64_t next, bool has_prev,
+                      int64_t prev) {
+  put_raw(r, "{\"action\":", 10);
+  put_i64(r, action);
+  put_raw(r, ",\"oid\":", 7);
+  put_i64(r, oid);
+  put_raw(r, ",\"aid\":", 7);
+  put_i64(r, aid);
+  put_raw(r, ",\"sid\":", 7);
+  put_i64(r, sid);
+  put_raw(r, ",\"price\":", 9);
+  put_i64(r, price);
+  put_raw(r, ",\"size\":", 8);
+  put_i64(r, size);
+  put_raw(r, ",\"next\":", 8);
+  if (has_next) put_i64(r, next); else put_raw(r, "null", 4);
+  put_raw(r, ",\"prev\":", 8);
+  if (has_prev) put_i64(r, prev); else put_raw(r, "null", 4);
+  put_raw(r, "}", 1);
+}
+
+inline void start_line(Recon& r, const char* key, int64_t klen) {
+  r.line_off[r.n_lines++] = r.len;
+  put_raw(r, key, klen);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kme_recon_new() { return new Recon(); }
+void kme_recon_free(void* p) { delete static_cast<Recon*>(p); }
+
+const char* kme_recon_buf(void* p) { return static_cast<Recon*>(p)->buf; }
+int64_t kme_recon_len(void* p) { return static_cast<Recon*>(p)->len; }
+int64_t kme_recon_n_lines(void* p) {
+  return static_cast<Recon*>(p)->n_lines;
+}
+const int64_t* kme_recon_line_off(void* p) {
+  return static_cast<Recon*>(p)->line_off;
+}
+const int32_t* kme_recon_msg_lines(void* p) {
+  return static_cast<Recon*>(p)->msg_lines;
+}
+
+// Returns 0 on success. All per-message arrays are in arrival order.
+// d_* arrays are valid where d_isdev != 0; trades carry d_sid (the
+// lane's symbol) and their fills live at f_*[d_off .. d_off+d_nfill).
+int32_t kme_recon_wire(
+    int64_t nmsg, const int64_t* m_action, const int64_t* m_oid,
+    const int64_t* m_aid, const int64_t* m_sid, const int64_t* m_price,
+    const int64_t* m_size, const int64_t* m_next, const uint8_t* m_has_next,
+    const int64_t* m_prev, const uint8_t* m_has_prev,
+    const uint8_t* d_isdev, const int32_t* d_act, const uint8_t* d_ok,
+    const int32_t* d_nfill, const int64_t* d_off, const int64_t* d_residual,
+    const int64_t* d_prev_oid, const uint8_t* d_append, const int64_t* d_sid,
+    int64_t nfills, const int64_t* f_oid, const int64_t* f_aid,
+    const int64_t* f_price, const int64_t* f_size, void* handle) {
+  Recon& r = *static_cast<Recon*>(handle);
+  // worst-case line budget: IN + OUT per message + 2 lines per fill.
+  // Longest line: "OUT " (4) + 65 bytes of JSON scaffolding + 8 fields
+  // of up to 20 chars (int64 min) = 229; 240 leaves slack.
+  int64_t lines = 2 * nmsg + 2 * nfills;
+  int64_t need = 240 * lines + 64;
+  if (r.cap < need) {
+    delete[] r.buf;
+    r.buf = new char[need];
+    r.cap = need;
+  }
+  if (r.lines_cap < lines) {
+    delete[] r.line_off;
+    r.line_off = new int64_t[lines];
+    r.lines_cap = lines;
+  }
+  if (r.nmsg_cap < nmsg) {
+    delete[] r.msg_lines;
+    r.msg_lines = new int32_t[nmsg];
+    r.nmsg_cap = nmsg;
+  }
+  r.len = 0;
+  r.n_lines = 0;
+
+  for (int64_t i = 0; i < nmsg; i++) {
+    int64_t lines0 = r.n_lines;
+    start_line(r, "IN ", 3);
+    put_order(r, m_action[i], m_oid[i], m_aid[i], m_sid[i], m_price[i],
+              m_size[i], m_has_next[i], m_next[i], m_has_prev[i],
+              m_prev[i]);
+    bool isdev = d_isdev[i] != 0;
+    bool ok = isdev && d_ok[i] != 0;
+    if (!ok) {
+      start_line(r, "OUT ", 4);
+      put_order(r, OP_REJECT, m_oid[i], m_aid[i], m_sid[i], m_price[i],
+                m_size[i], m_has_next[i], m_next[i], m_has_prev[i],
+                m_prev[i]);
+    } else {
+      int32_t act = d_act[i];
+      bool is_trade = act == L_BUY || act == L_SELL;
+      if (is_trade) {
+        int64_t sid = d_sid[i];
+        int64_t mk = act == L_BUY ? OP_SOLD : OP_BOUGHT;
+        int64_t tk = act == L_BUY ? OP_BOUGHT : OP_SOLD;
+        int64_t o0 = d_off[i];
+        for (int32_t e = 0; e < d_nfill[i]; e++) {
+          start_line(r, "OUT ", 4);
+          put_order(r, mk, f_oid[o0 + e], f_aid[o0 + e], sid, 0,
+                    f_size[o0 + e], false, 0, false, 0);
+          start_line(r, "OUT ", 4);
+          put_order(r, tk, m_oid[i], m_aid[i], sid,
+                    m_price[i] - f_price[o0 + e], f_size[o0 + e],
+                    false, 0, false, 0);
+        }
+        start_line(r, "OUT ", 4);
+        bool app = d_append[i] != 0;
+        put_order(r, m_action[i], m_oid[i], m_aid[i], m_sid[i],
+                  m_price[i], d_residual[i], m_has_next[i], m_next[i],
+                  app || m_has_prev[i], app ? d_prev_oid[i] : m_prev[i]);
+      } else {
+        start_line(r, "OUT ", 4);
+        put_order(r, m_action[i], m_oid[i], m_aid[i], m_sid[i],
+                  m_price[i], m_size[i], m_has_next[i], m_next[i],
+                  m_has_prev[i], m_prev[i]);
+      }
+    }
+    r.msg_lines[i] = static_cast<int32_t>(r.n_lines - lines0);
+  }
+  return 0;
+}
+
+}  // extern "C"

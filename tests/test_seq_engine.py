@@ -208,3 +208,27 @@ def test_seq_hash_full_error():
         raised = True
         assert e.code == SQ.LERR_HASH_FULL
     assert raised
+
+
+def test_seq_native_wire_equivalence():
+    """The C++ reconstructor (native/kme_wire.cpp) and the pure-Python
+    path must produce identical line streams; process_wire_buffer's
+    offsets must re-slice to the same lines."""
+    cfg = SQ.SeqConfig(lanes=8, slots=128, accounts=128, max_fills=64,
+                       batch=256, pos_cap=1 << 11, fill_cap=1 << 13,
+                       probe_max=16)
+    msgs = harness_stream(700, seed=5)
+    a = SeqSession(cfg)
+    r = a.process_wire_buffer([m.copy() for m in msgs])
+    if r is None:
+        pytest.skip("native library unavailable")
+    buf, line_off, msg_lines = r
+    text = buf.decode("ascii")
+    flat = [text[line_off[k]:line_off[k + 1]]
+            for k in range(len(line_off) - 1)]
+    b = SeqSession(cfg)
+    b._use_native_wire = False
+    py = b.process_wire([m.copy() for m in msgs])
+    pyflat = [l for ls in py for l in ls]
+    assert flat == pyflat
+    assert int(msg_lines.sum()) == len(pyflat)
