@@ -104,6 +104,15 @@ class SeqConfig:
     pos_cap: int = 1 << 17     # position hash capacity (pow2 mult of 128)
     fill_cap: int = 1 << 15    # fill entries per call (mult of 128)
     probe_max: int = 64        # max hash tiles probed before HASH_FULL
+    # hbm_books: book planes live in HBM (pl.ANY) and the kernel keeps
+    # ONE lane's rows in a VMEM scratch cache, flushed/loaded on lane
+    # switch. VMEM cannot hold deep books (slots=8192 at S=1024 is
+    # ~400MB across the planes); the Zipf hot lane needs thousands of
+    # resting slots for the envelope to stop rejecting flow the
+    # reference (unbounded lists, KProcessor.java:200-223) accepts.
+    # Lane locality makes switches cheap, and HBM bandwidth (~800GB/s)
+    # dwarfs the ~64KB/plane moved per switch.
+    hbm_books: bool = False
 
     def __post_init__(self):
         assert self.slots % LN == 0 and self.slots >= LN
@@ -264,12 +273,20 @@ def build_seq_step(cfg: SeqConfig):
     PROBE = min(cfg.probe_max, CAPR)
     CAPMASK = _i(cfg.pos_cap - 1)
 
+    HBM = cfg.hbm_books
+    BOOK_KEYS = ("bo_lo", "bo_hi", "ba", "bp", "bs", "bq")
+
     def kernel(act_s, oidlo_s, oidhi_s, aid_s, price_s, size_s, lane_s,
                *refs):
-        # refs: 17 aliased state ins, then 17 state outs + out plane.
-        outs = refs[len(_STATE_KEYS):]
-        st = dict(zip(_STATE_KEYS, outs[:len(_STATE_KEYS)]))
-        out = outs[len(_STATE_KEYS)]
+        # refs: 17 aliased state ins, 17 state outs + out plane, then
+        # (hbm_books) 6 VMEM scratch planes + a DMA semaphore array.
+        nst = len(_STATE_KEYS)
+        outs = refs[nst:]
+        st = dict(zip(_STATE_KEYS, outs[:nst]))
+        out = outs[nst]
+        if HBM:
+            scr = dict(zip(BOOK_KEYS, refs[nst + nst + 1:nst + nst + 7]))
+            dsem = refs[nst + nst + 7]
 
         ci = jax.lax.broadcasted_iota(I32, (1, LN), 1)
         # flat slot index over an (NR, 128) side block
@@ -406,18 +423,56 @@ def build_seq_step(cfg: SeqConfig):
                            jnp.where(dead, z, nvhi))
 
         # -------- book row access -------------------------------------
+        # Under hbm_books the CURRENT lane's rows live in the VMEM
+        # scratch cache (lane arg ignored; the switch logic in `one`
+        # guarantees the cache holds the message's lane before any
+        # book-touching path runs).
         def side_base(lane, side):
             return lane * _i(2 * NR) + side * _i(NR)
 
-        def side_blk(ref, lane, side):
-            return ref[pl.ds(side_base(lane, side), NR), :]
+        def _rows(start, n):
+            """Static slice for constant starts (pl.ds rejects numpy
+            scalars), dynamic pl.ds for traced ones."""
+            if isinstance(start, (int, np.integer)):
+                return slice(int(start), int(start) + n)
+            return pl.ds(start, n)
 
-        def side_put(ref, lane, side, blk):
-            ref[pl.ds(side_base(lane, side), NR), :] = blk
+        def side_blk(key, lane, side):
+            if HBM:
+                return scr[key][_rows(side * _i(NR), NR), :]
+            return st[key][_rows(side_base(lane, side), NR), :]
 
-        def slot_write(ref, lane, side, f, v):
-            blk = side_blk(ref, lane, side)
-            side_put(ref, lane, side, jnp.where(fi == f, v, blk))
+        def side_put(key, lane, side, blk):
+            if HBM:
+                scr[key][_rows(side * _i(NR), NR), :] = blk
+            else:
+                st[key][_rows(side_base(lane, side), NR), :] = blk
+
+        def slot_write(key, lane, side, f, v):
+            blk = side_blk(key, lane, side)
+            side_put(key, lane, side, jnp.where(fi == f, v, blk))
+
+        def books_flush(cur):
+            """scratch -> HBM rows of lane `cur` (all 6 planes)."""
+            for k_, key in enumerate(BOOK_KEYS):
+                pltpu.make_async_copy(
+                    scr[key], st[key].at[pl.ds(cur * _i(2 * NR), 2 * NR)],
+                    dsem.at[_i(k_)]).start()
+            for k_, key in enumerate(BOOK_KEYS):
+                pltpu.make_async_copy(
+                    scr[key], st[key].at[pl.ds(cur * _i(2 * NR), 2 * NR)],
+                    dsem.at[_i(k_)]).wait()
+
+        def books_load(lane):
+            """HBM rows of `lane` -> scratch (all 6 planes)."""
+            for k_, key in enumerate(BOOK_KEYS):
+                pltpu.make_async_copy(
+                    st[key].at[pl.ds(lane * _i(2 * NR), 2 * NR)],
+                    scr[key], dsem.at[_i(k_)]).start()
+            for k_, key in enumerate(BOOK_KEYS):
+                pltpu.make_async_copy(
+                    st[key].at[pl.ds(lane * _i(2 * NR), 2 * NR)],
+                    scr[key], dsem.at[_i(k_)]).wait()
 
         # -------- margin release shared by cancel + wipe --------------
         def release_margin(lane, acc, o_isbuy, o_price, o_size):
@@ -464,7 +519,7 @@ def build_seq_step(cfg: SeqConfig):
 
         # ==============================================================
         def one(m, carry):
-            (fill_total, met) = carry
+            (fill_total, cur_lane, met) = carry
             act = act_s[m]
             lane = lane_s[m]
             acc = aid_s[m]
@@ -483,6 +538,20 @@ def build_seq_step(cfg: SeqConfig):
             opp = _i(1) - side
             # sgn: buy -> +1 (low ask first), sell -> -1 (high bid first)
             sgn = jnp.where(is_buy, _i(1), _i(-1))
+
+            if HBM:
+                needs_books = is_trade | is_cancel | is_barrier
+                do_switch = needs_books & (lane != cur_lane)
+
+                @pl.when(do_switch & (cur_lane >= _i(0)))
+                def _():
+                    books_flush(cur_lane)
+
+                @pl.when(do_switch)
+                def _():
+                    books_load(lane)
+
+                cur_lane = jnp.where(do_switch, lane, cur_lane)
 
             lr, ll = lane >> _i(7), lane & _i(127)
             bex_v = rget(st["bex"], lr, ll) != _i(0)
@@ -525,9 +594,9 @@ def build_seq_step(cfg: SeqConfig):
                         & ~_lt64(blo, bhi, risk_lo, risk_hi))
 
             # ---------------- TRADE phase 1: non-mutating sweep -------
-            op_blk = side_blk(st["bp"], lane, opp)
-            os_blk = side_blk(st["bs"], lane, opp)
-            oq_blk = side_blk(st["bq"], lane, opp)
+            op_blk = side_blk("bp", lane, opp)
+            os_blk = side_blk("bs", lane, opp)
+            oq_blk = side_blk("bq", lane, opp)
 
             def sweep(c):
                 wsize, fslot, ffill, remaining, e, ovf, done = c
@@ -559,9 +628,9 @@ def build_seq_step(cfg: SeqConfig):
                 jax.lax.while_loop(lambda c: ~c[6], sweep, init)
 
             # ---------------- capacity envelope + Q9 ------------------
-            w_blk = side_blk(st["bs"], lane, side)      # own side sizes
-            wp_blk = side_blk(st["bp"], lane, side)
-            wq_blk = side_blk(st["bq"], lane, side)
+            w_blk = side_blk("bs", lane, side)      # own side sizes
+            wp_blk = side_blk("bp", lane, side)
+            wq_blk = side_blk("bq", lane, side)
             free_flat = jnp.min(jnp.where(w_blk == _i(0), fi, BIG))
             have_free = free_flat < BIG
             rest_want = trade_ok & (residual_t > _i(0))
@@ -577,8 +646,8 @@ def build_seq_step(cfg: SeqConfig):
             tail_at = same_level & (wq_blk == smax)
             tail_flat = jnp.min(jnp.where(tail_at, fi, BIG))
             tfc = jnp.where(bucket_nonempty, tail_flat, _i(0))
-            tail_lo = pick2(side_blk(st["bo_lo"], lane, side), tfc)
-            tail_hi = pick2(side_blk(st["bo_hi"], lane, side), tfc)
+            tail_lo = pick2(side_blk("bo_lo", lane, side), tfc)
+            tail_hi = pick2(side_blk("bo_hi", lane, side), tfc)
             append = bucket_nonempty & do_rest
 
             # ---------------- TRADE phase 2: apply --------------------
@@ -601,11 +670,11 @@ def build_seq_step(cfg: SeqConfig):
                         set_err(_i(LERR_HASH_FULL))
 
                 # maker size writeback (size==0 deletes the slot)
-                side_put(st["bs"], lane, opp, wsize)
+                side_put("bs", lane, opp, wsize)
 
-                oa_blk = side_blk(st["ba"], lane, opp)
-                olo_blk = side_blk(st["bo_lo"], lane, opp)
-                ohi_blk = side_blk(st["bo_hi"], lane, opp)
+                oa_blk = side_blk("ba", lane, opp)
+                olo_blk = side_blk("bo_lo", lane, opp)
+                ohi_blk = side_blk("bo_hi", lane, opp)
 
                 def apply_fill(e2, _c):
                     flat = pick(fslot, e2)
@@ -650,22 +719,22 @@ def build_seq_step(cfg: SeqConfig):
                 @pl.when(do_rest)
                 def _():
                     seqv = rget(st["seqc"], lr, ll)
-                    slot_write(st["bo_lo"], lane, side, free_flat, t_oidlo)
-                    slot_write(st["bo_hi"], lane, side, free_flat, t_oidhi)
-                    slot_write(st["ba"], lane, side, free_flat, acc)
-                    slot_write(st["bp"], lane, side, free_flat, limit)
-                    slot_write(st["bs"], lane, side, free_flat, residual_t)
-                    slot_write(st["bq"], lane, side, free_flat, seqv)
+                    slot_write("bo_lo", lane, side, free_flat, t_oidlo)
+                    slot_write("bo_hi", lane, side, free_flat, t_oidhi)
+                    slot_write("ba", lane, side, free_flat, acc)
+                    slot_write("bp", lane, side, free_flat, limit)
+                    slot_write("bs", lane, side, free_flat, residual_t)
+                    slot_write("bq", lane, side, free_flat, seqv)
                     put(st["seqc"], lr, ll, seqv + _i(1))
 
             # ---------------- CANCEL ----------------------------------
             # search both sides for the oid among occupied slots
-            b0 = side_blk(st["bo_lo"], lane, _i(0))
-            b0h = side_blk(st["bo_hi"], lane, _i(0))
-            s0 = side_blk(st["bs"], lane, _i(0))
-            b1 = side_blk(st["bo_lo"], lane, _i(1))
-            b1h = side_blk(st["bo_hi"], lane, _i(1))
-            s1 = side_blk(st["bs"], lane, _i(1))
+            b0 = side_blk("bo_lo", lane, _i(0))
+            b0h = side_blk("bo_hi", lane, _i(0))
+            s0 = side_blk("bs", lane, _i(0))
+            b1 = side_blk("bo_lo", lane, _i(1))
+            b1h = side_blk("bo_hi", lane, _i(1))
+            s1 = side_blk("bs", lane, _i(1))
             hit0 = (s0 > _i(0)) & (b0 == t_oidlo) & (b0h == t_oidhi)
             hit1 = (s1 > _i(0)) & (b1 == t_oidlo) & (b1h == t_oidhi)
             f0 = jnp.min(jnp.where(hit0, fi, BIG))
@@ -674,14 +743,14 @@ def build_seq_step(cfg: SeqConfig):
             c_flat = jnp.where(f0 < BIG, f0, f1)
             hit_any = is_cancel & (c_flat < BIG)
             cfc = jnp.where(hit_any, c_flat, _i(0))
-            c_aid = pick2(side_blk(st["ba"], lane, c_side), cfc)
-            c_price = pick2(side_blk(st["bp"], lane, c_side), cfc)
-            c_size = pick2(side_blk(st["bs"], lane, c_side), cfc)
+            c_aid = pick2(side_blk("ba", lane, c_side), cfc)
+            c_price = pick2(side_blk("bp", lane, c_side), cfc)
+            c_size = pick2(side_blk("bs", lane, c_side), cfc)
             cancel_ok = hit_any & (c_aid == acc)
 
             @pl.when(cancel_ok)
             def _():
-                slot_write(st["bs"], lane, c_side, c_flat, _i(0))
+                slot_write("bs", lane, c_side, c_flat, _i(0))
                 rlo, rhi = release_margin(lane, acc, c_side == _i(0),
                                           c_price, c_size)
                 bal_add(acc, rlo, rhi)
@@ -694,13 +763,13 @@ def build_seq_step(cfg: SeqConfig):
                 # wipe both sides with margin release, buy side first,
                 # (price, seq) order within a side (_wipe_book_fixed)
                 def wipe_side(wside):
-                    pb = side_blk(st["bp"], lane, wside)
-                    qb = side_blk(st["bq"], lane, wside)
-                    ab = side_blk(st["ba"], lane, wside)
+                    pb = side_blk("bp", lane, wside)
+                    qb = side_blk("bq", lane, wside)
+                    ab = side_blk("ba", lane, wside)
 
                     def w_body(c):
                         _k, done = c
-                        sb = side_blk(st["bs"], lane, wside)
+                        sb = side_blk("bs", lane, wside)
                         used = sb > _i(0)
                         pmin = jnp.min(jnp.where(used, pb, BIG))
                         anyu = pmin < BIG
@@ -717,7 +786,7 @@ def build_seq_step(cfg: SeqConfig):
                             o_aid = pick2(ab, fc)
                             o_price = pick2(pb, fc)
                             o_size = pick2(sb, fc)
-                            slot_write(st["bs"], lane, wside, fc, _i(0))
+                            slot_write("bs", lane, wside, fc, _i(0))
                             rlo, rhi = release_margin(
                                 lane, o_aid, wside == _i(0),
                                 o_price, o_size)
@@ -834,10 +903,15 @@ def build_seq_step(cfg: SeqConfig):
                 met[11] + cnt(barrier_do),
             )
             fill_total2 = fill_total + nf
-            return (fill_total2, met)
+            return (fill_total2, cur_lane, met)
 
         met0 = tuple(_i(0) for _ in range(N_METRICS))
-        fill_total, met = _fori32(B, one, (_i(0), met0))
+        fill_total, cur_lane, met = _fori32(
+            B, one, (_i(0), _i(-1), met0))
+        if HBM:
+            @pl.when(cur_lane >= _i(0))
+            def _():
+                books_flush(cur_lane)
 
         # scalar row: lane0 err, lane1 fill_total, lanes 2.. metrics
         errv = pick(st["err"][0:1, :], _i(0))
@@ -849,6 +923,15 @@ def build_seq_step(cfg: SeqConfig):
 
     nstate = len(_STATE_KEYS)
 
+    def _spec(key):
+        if cfg.hbm_books and key in BOOK_KEYS:
+            return pl.BlockSpec(memory_space=pl.ANY)
+        return pl.BlockSpec(memory_space=pltpu.VMEM)
+
+    scratches = ([pltpu.VMEM((2 * NR, LN), I32)] * 6
+                 + [pltpu.SemaphoreType.DMA((6,))]) if cfg.hbm_books \
+        else []
+
     def raw_call(state, msgs):
         outs = pl.pallas_call(
             kernel,
@@ -857,10 +940,11 @@ def build_seq_step(cfg: SeqConfig):
                  for k in _STATE_KEYS]
                 + [jax.ShapeDtypeStruct((NROWS, LN), I32)]),
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 7
-            + [pl.BlockSpec(memory_space=pltpu.VMEM)] * nstate,
-            out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)]
-                            * (nstate + 1)),
+            + [_spec(k) for k in _STATE_KEYS],
+            out_specs=tuple([_spec(k) for k in _STATE_KEYS]
+                            + [pl.BlockSpec(memory_space=pltpu.VMEM)]),
             input_output_aliases={7 + k: k for k in range(nstate)},
+            scratch_shapes=scratches,
             interpret=jax.default_backend() != "tpu",
         )(msgs["act"], msgs["oid_lo"], msgs["oid_hi"], msgs["aid"],
           msgs["price"], msgs["size"], msgs["lane"],

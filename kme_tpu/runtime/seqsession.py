@@ -318,14 +318,21 @@ class SeqSession:
                 lut[lane] = sid
             d_sid[mi] = lut[cols["lane"]]
         idx2aid = np.array(self.router.acct_of_idx() or [0], np.int64)
-        f_aid = idx2aid[fills[1]] if fills.shape[1] else             np.zeros(0, np.int64)
+        f_aid = (idx2aid[fills[1]] if fills.shape[1]
+                 else np.zeros(0, np.int64))
         f_oid = np.ascontiguousarray(fills[0])
         f_aid = np.ascontiguousarray(f_aid)
         f_price = np.ascontiguousarray(fills[2])
         f_size = np.ascontiguousarray(fills[3])
 
         if self._recon is None:
+            import weakref
+
             self._recon = lib.kme_recon_new()
+            # release the native buffer with the session (no __del__:
+            # a finalizer survives interpreter-shutdown ordering)
+            self._recon_fin = weakref.finalize(
+                self, lib.kme_recon_free, self._recon)
         c = ctypes
         P64 = c.POINTER(c.c_int64)
         P32 = c.POINTER(c.c_int32)

@@ -232,3 +232,13 @@ def test_seq_native_wire_equivalence():
     pyflat = [l for ls in py for l in ls]
     assert flat == pyflat
     assert int(msg_lines.sum()) == len(pyflat)
+
+
+def test_seq_hbm_books_parity():
+    """hbm_books: book planes in HBM behind the kernel's per-lane VMEM
+    scratch cache — same byte parity, exercised at slots=256 (NR=2) so
+    multi-row blocks and lane switches are both covered."""
+    msgs = zipf_symbol_stream(500, num_symbols=6, num_accounts=24, seed=3)
+    assert_seq_parity(msgs, SQ.SeqConfig(
+        lanes=8, slots=256, accounts=128, max_fills=64, batch=256,
+        pos_cap=1 << 11, fill_cap=1 << 13, probe_max=16, hbm_books=True))
