@@ -16,15 +16,18 @@ Layout (SURVEY.md §2.3 "TPU-native equivalent", §7 step 6):
 The same step function works single-device (axis_name=None) — the
 sharded build is a thin shard_map wrapper around engine/lanes.py.
 
-Multi-host (DCN) story: the mesh is built from jax.devices(), so under
+Multi-host (DCN): the mesh is built from jax.devices(), so under
 `jax.distributed.initialize()` the same code spans hosts — the symbol
 axis lays contiguous lane blocks per process, keeping the per-step
 balance/metric psum on ICI within a slice and crossing DCN only for the
 rare barrier settles and the replicated (A,)-sized merges (the only
 cross-shard traffic this design has; fills ride the GSPMD gather in
-kme_tpu/engine/lanes.py chunk_compaction). Single-process multi-device
-execution is what this environment can validate (8-way virtual mesh in
-tests + the driver dryrun); nothing in the layout is process-local.
+kme_tpu/engine/lanes.py chunk_compaction). EXECUTED EVIDENCE:
+tests/test_multihost.py runs the sharded session SPMD across two OS
+processes (4 virtual CPU devices each, one 8-way jax.distributed mesh)
+and requires the wire stream bit-identical to a single-process run —
+the reference analog of multiple Streams instances joining one group
+(KProcessor.java:59-60).
 """
 
 from __future__ import annotations

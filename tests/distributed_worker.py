@@ -1,0 +1,53 @@
+"""Worker process for the 2-process jax.distributed multi-host test.
+
+Each worker owns 4 virtual CPU devices; the two workers form one
+8-device mesh via jax.distributed, and the sharded LaneSession runs
+SPMD across the process boundary — the DCN topology of SURVEY.md §2.3
+("cross-node comm backend"), validated without real hosts the idiomatic
+JAX way. Usage (spawned by tests/test_multihost.py):
+
+    python distributed_worker.py <coordinator> <nprocs> <pid> <outfile>
+"""
+
+import hashlib
+import os
+import sys
+
+# The spawning test pins JAX_PLATFORMS=cpu and the 4-device XLA flag in
+# this process's ENVIRONMENT (the axon site can initialize jax at
+# interpreter startup, so setting os.environ here would be too late).
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    coordinator, nprocs, pid, outfile = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=nprocs, process_id=pid)
+    assert jax.device_count() == 4 * nprocs, jax.devices()
+    assert jax.process_count() == nprocs
+
+    from kme_tpu.engine.lanes import LaneConfig
+    from kme_tpu.runtime.session import LaneSession
+    from kme_tpu.workload import zipf_symbol_stream
+
+    cfg = LaneConfig(lanes=16, slots=128, accounts=64, max_fills=32,
+                     steps=32)
+    msgs = zipf_symbol_stream(1500, num_symbols=12, num_accounts=24,
+                              seed=17)
+    ses = LaneSession(cfg, shards=8)   # mesh spans both processes
+    out = ses.process_wire(msgs)
+    blob = "\n".join(l for ls in out for l in ls).encode()
+    digest = hashlib.sha256(blob).hexdigest()
+    with open(outfile, "w") as f:
+        f.write(f"{digest} {len(blob)}\n")
+    # keep both processes alive until collectives drain
+    jax.effects_barrier()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
