@@ -1,0 +1,30 @@
+#!/usr/bin/env sh
+# Replay a conformance fixture through the real KProcessor and diff.
+# Usage: ./replay_jvm.sh <fixture-name> [bootstrap]
+# Prereq: broker up (docker-compose.yml), topics created, KProcessor
+# running with fresh state stores (see README.md in this directory).
+set -eu
+NAME="${1:?usage: replay_jvm.sh <fixture> [bootstrap]}"
+BOOTSTRAP="${2:-localhost:9092}"
+HERE="$(cd "$(dirname "$0")" && pwd)"
+IN="$HERE/$NAME.in.jsonl"
+WANT="$HERE/$NAME.expected.txt"
+[ -f "$IN" ] || { echo "no fixture $IN" >&2; exit 2; }
+NLINES=$(wc -l < "$WANT")
+
+echo "producing $(wc -l < "$IN") messages to MatchIn..." >&2
+kafka-console-producer --bootstrap-server "$BOOTSTRAP" \
+    --topic MatchIn < "$IN"
+
+echo "draining $NLINES lines from MatchOut..." >&2
+kafka-console-consumer --bootstrap-server "$BOOTSTRAP" \
+    --topic MatchOut --from-beginning --max-messages "$NLINES" \
+    --property print.key=true --property key.separator=' ' \
+    --timeout-ms 60000 > "/tmp/$NAME.got.txt"
+
+if diff -u "$WANT" "/tmp/$NAME.got.txt"; then
+    echo "CONFORMANCE PASS: $NAME byte-exact" >&2
+else
+    echo "CONFORMANCE FAIL: $NAME diverged (see diff above)" >&2
+    exit 1
+fi

@@ -17,6 +17,13 @@ import sys
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kme-serve", description=__doc__)
     p.add_argument("--listen", default="127.0.0.1:9092", metavar="HOST:PORT")
+    p.add_argument("--kafka", default=None, metavar="BOOTSTRAP",
+                   help="serve against a REAL Kafka cluster through the "
+                        "aiokafka transport (bridge/kafka.py) instead of "
+                        "hosting the in-process broker: topics/offsets "
+                        "live in Kafka (durable there), --listen/--log-dir "
+                        "are ignored, and the reference's unmodified Node "
+                        "harness can drive the engine")
     p.add_argument("--engine", choices=("lanes", "oracle", "native"),
                    default="lanes",
                    help="lanes = device throughput engine (fixed mode); "
@@ -49,6 +56,11 @@ def main(argv=None) -> int:
     p.add_argument("--auto-provision", action="store_true")
     p.add_argument("--max-messages", type=int, default=None)
     p.add_argument("--idle-exit", type=float, default=None, metavar="SECS")
+    p.add_argument("--health-file", default=None, metavar="PATH",
+                   help="write a {pid, time, seen, offset} heartbeat JSON "
+                        "here (atomic replace) every --health-every "
+                        "seconds; kme-supervise watches its mtime")
+    p.add_argument("--health-every", type=float, default=1.0)
     args = p.parse_args(argv)
 
     import os
@@ -58,15 +70,22 @@ def main(argv=None) -> int:
     from kme_tpu.bridge.service import MatchService
     from kme_tpu.bridge.tcp import parse_addr, serve_broker
 
-    log_dir = args.log_dir
-    if log_dir is None and args.checkpoint_dir is not None:
-        log_dir = os.path.join(args.checkpoint_dir, "broker-log")
-    broker = InProcessBroker(persist_dir=log_dir)
-    host, port = parse_addr(args.listen)
-    srv, broker = serve_broker(host, port, broker)
-    real_host, real_port = srv.server_address[:2]
-    print(f"kme-serve: broker listening on {real_host}:{real_port}",
-          file=sys.stderr)
+    if args.kafka is not None:
+        from kme_tpu.bridge.kafka import KafkaBroker
+
+        broker = KafkaBroker(args.kafka)
+        srv = None
+        print(f"kme-serve: using Kafka at {args.kafka}", file=sys.stderr)
+    else:
+        log_dir = args.log_dir
+        if log_dir is None and args.checkpoint_dir is not None:
+            log_dir = os.path.join(args.checkpoint_dir, "broker-log")
+        broker = InProcessBroker(persist_dir=log_dir)
+        host, port = parse_addr(args.listen)
+        srv, broker = serve_broker(host, port, broker)
+        real_host, real_port = srv.server_address[:2]
+        print(f"kme-serve: broker listening on {real_host}:{real_port}",
+              file=sys.stderr)
     if args.auto_provision:
         provision(broker)
     svc = MatchService(broker, engine=args.engine, compat=args.compat,
@@ -78,7 +97,9 @@ def main(argv=None) -> int:
                        checkpoint_every=args.checkpoint_every)
     try:
         seen = svc.run(max_messages=args.max_messages,
-                       idle_exit=args.idle_exit)
+                       idle_exit=args.idle_exit,
+                       health_file=args.health_file,
+                       health_every=args.health_every)
         if args.checkpoint_dir is not None:
             svc.checkpoint()
         print(f"kme-serve: processed {seen} records", file=sys.stderr)
@@ -90,5 +111,12 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        srv.shutdown()
+        if srv is not None:
+            srv.shutdown()
+        if hasattr(broker, "close"):
+            broker.close()
     return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -262,20 +262,64 @@ class MatchService:
 
     def run(self, max_messages: Optional[int] = None,
             idle_exit: Optional[float] = None,
-            poll_timeout: float = 0.5) -> int:
+            poll_timeout: float = 0.5,
+            health_file: Optional[str] = None,
+            health_every: float = 1.0) -> int:
         """Serve until max_messages consumed (None = forever) or the
-        input topic stays idle for `idle_exit` seconds."""
+        input topic stays idle for `idle_exit` seconds.
+
+        health_file: heartbeat surface for the supervisor (kme-supervise)
+        — a JSON snapshot {pid, time, seen, offset} atomically replaced
+        every `health_every` seconds FROM A BACKGROUND THREAD, so a
+        legitimately long step (first-batch XLA compile, a large
+        checkpoint write) does not read as a hang; a stale mtime means
+        the PROCESS froze or died (the reference delegates liveness to
+        Kafka's group-membership heartbeats, KProcessor.java:59-60 via
+        the Streams library)."""
+        import threading
         import time
 
         seen = 0
-        idle_since = time.monotonic()
-        while max_messages is None or seen < max_messages:
-            n = self.step(timeout=poll_timeout)
-            now = time.monotonic()
-            if n == 0:
-                if idle_exit is not None and now - idle_since >= idle_exit:
-                    break
-            else:
-                idle_since = now
-                seen += n
+        beat_stop = None
+        if health_file is not None:
+            beat_stop = threading.Event()
+            state = self
+
+            def beater():
+                while not beat_stop.wait(health_every):
+                    state._write_heartbeat(health_file, seen_box[0])
+
+            seen_box = [0]
+            self._write_heartbeat(health_file, 0)
+            t = threading.Thread(target=beater, daemon=True)
+            t.start()
+        try:
+            idle_since = time.monotonic()
+            while max_messages is None or seen < max_messages:
+                n = self.step(timeout=poll_timeout)
+                now = time.monotonic()
+                if n == 0:
+                    if idle_exit is not None \
+                            and now - idle_since >= idle_exit:
+                        break
+                else:
+                    idle_since = now
+                    seen += n
+                    if health_file is not None:
+                        seen_box[0] = seen
+        finally:
+            if beat_stop is not None:
+                beat_stop.set()
+                self._write_heartbeat(health_file, seen)
         return seen
+
+    def _write_heartbeat(self, path: str, seen: int) -> None:
+        import json
+        import os
+        import time as _t
+
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"pid": os.getpid(), "time": _t.time(),
+                       "seen": seen, "offset": self.offset}, f)
+        os.replace(tmp, path)

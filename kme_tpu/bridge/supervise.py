@@ -1,0 +1,120 @@
+"""kme-supervise: failure detection + automatic restart for kme-serve.
+
+The reference gets this for free from Kafka Streams group membership —
+a died instance's partitions are reassigned and state is restored from
+changelogs (KProcessor.java:59-60, library behavior). Here the same
+role is played by a supervisor process: it launches `kme-serve` as a
+child with a heartbeat file (--health-file) and a checkpoint directory,
+and restarts the child from its newest checkpoint whenever
+
+- the child process exits with a non-zero status, or
+- the heartbeat goes STALE (mtime older than --stale-after seconds —
+  the liveness signal; a hung process is as dead as a crashed one).
+
+Durability is the existing checkpoint/resume contract: broker topic
+logs persist under the checkpoint dir, the child resumes from the
+newest fsync'd snapshot, and at-least-once replay of the input tail
+reproduces the byte-exact output stream
+(tests/test_supervise.py kills the child mid-stream and requires the
+completed MatchOut stream to equal the oracle's).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _alive(proc: subprocess.Popen) -> bool:
+    return proc.poll() is None
+
+
+def _hb_age(path: str) -> float:
+    try:
+        return time.time() - os.stat(path).st_mtime
+    except OSError:
+        return float("inf")
+
+
+def supervise(serve_args, checkpoint_dir: str, stale_after: float = 10.0,
+              max_restarts: int = 5, grace: float = 5.0,
+              poll: float = 0.5, echo: bool = True) -> int:
+    """Run kme-serve under supervision; returns the child's final rc.
+
+    serve_args: argv tail passed to `kme-serve` verbatim (the supervisor
+    adds --checkpoint-dir and --health-file itself)."""
+    hb = os.path.join(checkpoint_dir, "serve.health")
+    base = [sys.executable, "-m", "kme_tpu.cli", "serve",
+            "--checkpoint-dir", checkpoint_dir,
+            "--health-file", hb] + list(serve_args)
+    restarts = 0
+    while True:
+        if os.path.exists(hb):
+            os.unlink(hb)
+        if echo:
+            print(f"kme-supervise: starting kme-serve "
+                  f"(restart {restarts}/{max_restarts})", file=sys.stderr)
+        child = subprocess.Popen(base)
+        start = time.time()
+        failed = None
+        while True:
+            time.sleep(poll)
+            if not _alive(child):
+                rc = child.returncode
+                if rc == 0:
+                    if echo:
+                        print("kme-supervise: child exited cleanly",
+                              file=sys.stderr)
+                    return 0
+                failed = f"child exited rc={rc}"
+                break
+            age = _hb_age(hb)
+            # allow a startup grace window before the first heartbeat
+            if age == float("inf") and time.time() - start < grace:
+                continue
+            if age > stale_after:
+                failed = f"heartbeat stale ({age:.1f}s > {stale_after}s)"
+                break
+        if echo:
+            print(f"kme-supervise: FAILURE DETECTED: {failed}",
+                  file=sys.stderr)
+        if _alive(child):
+            child.send_signal(signal.SIGKILL)
+            child.wait()
+        restarts += 1
+        if restarts > max_restarts:
+            print("kme-supervise: restart budget exhausted", file=sys.stderr)
+            return 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kme-supervise", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--checkpoint-dir", required=True,
+                   help="checkpoint + broker-log + heartbeat directory "
+                        "(the restart state root)")
+    p.add_argument("--stale-after", type=float, default=10.0,
+                   help="heartbeat age that counts as a hang")
+    p.add_argument("--max-restarts", type=int, default=5)
+    p.add_argument("--grace", type=float, default=5.0,
+                   help="startup seconds before the first heartbeat is due")
+    p.add_argument("serve_args", nargs=argparse.REMAINDER,
+                   help="arguments after '--' go to kme-serve verbatim")
+    args = p.parse_args(argv)
+    serve_args = args.serve_args
+    if serve_args and serve_args[0] == "--":
+        serve_args = serve_args[1:]
+    os.makedirs(args.checkpoint_dir, exist_ok=True)
+    return supervise(serve_args, args.checkpoint_dir,
+                     stale_after=args.stale_after,
+                     max_restarts=args.max_restarts, grace=args.grace)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,16 +122,27 @@ def provision_main(argv=None) -> int:
     return _main(argv)
 
 
+def supervise_main(argv=None) -> int:
+    """Failure detection + supervised restart of kme-serve."""
+    try:
+        from kme_tpu.bridge.supervise import main as _main
+    except ImportError:
+        return _not_yet("the supervisor")
+    return _main(argv)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m kme_tpu.cli")
     p.add_argument("command", choices=(
-        "loadgen", "oracle", "bench", "serve", "consume", "provision"))
+        "loadgen", "oracle", "bench", "serve", "consume", "provision",
+        "supervise"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
             "loadgen": loadgen_main, "oracle": oracle_main,
             "bench": bench_main, "serve": serve_main,
             "consume": consume_main, "provision": provision_main,
+            "supervise": supervise_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

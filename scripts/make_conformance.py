@@ -1,0 +1,56 @@
+"""Regenerate the JVM conformance pack (artifacts/conformance/).
+
+The pack is the one-JVM-run validation path for the whole oracle chain
+(BASELINE.md): seeded input fixtures + the byte streams our java-mode
+oracle expects the real KProcessor to emit. Anyone with a JVM + Kafka
+replays the fixtures through the reference (replay_jvm.sh /
+docker-compose.yml in the pack) and diffs — a single run validates
+every quirk Q1-Q11 the parity engines replicate.
+
+Deterministic by construction: fixtures come from the seeded harness
+port (kme_tpu/workload.py — the exchange_test.js distribution) and
+expectations from the java-mode oracle; tests/test_conformance.py
+regenerates and requires byte-identical files.
+
+Usage: python scripts/make_conformance.py [outdir]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kme_tpu.oracle import OracleEngine  # noqa: E402
+from kme_tpu.wire import dumps_order  # noqa: E402
+from kme_tpu.workload import harness_stream  # noqa: E402
+
+FIXTURES = (
+    # (name, events, seed) — stock harness shape: 10 accounts, 3
+    # symbols, Q5 payout-opcode bug intact, no validation (the exact
+    # exchange_test.js distribution)
+    ("smoke_50", 50, 7),
+    ("harness_1k", 1000, 0),
+    ("harness_2k", 2000, 1),
+)
+
+
+def generate(outdir: str) -> None:
+    os.makedirs(outdir, exist_ok=True)
+    for name, events, seed in FIXTURES:
+        msgs = harness_stream(events, seed=seed)
+        eng = OracleEngine("java")
+        in_path = os.path.join(outdir, f"{name}.in.jsonl")
+        out_path = os.path.join(outdir, f"{name}.expected.txt")
+        with open(in_path, "w") as fi, open(out_path, "w") as fo:
+            for m in msgs:
+                fi.write(dumps_order(m) + "\n")
+                for rec in eng.process(m.copy()):
+                    fo.write(rec.wire() + "\n")
+        print(f"{name}: {len(msgs)} messages "
+              f"({os.path.getsize(out_path)} expected bytes)")
+
+
+if __name__ == "__main__":
+    generate(sys.argv[1] if len(sys.argv) > 1 else
+             os.path.join(os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__))), "artifacts", "conformance"))

@@ -1,0 +1,32 @@
+"""The conformance pack must be exactly what the oracle regenerates —
+the determinism contract that makes artifacts/conformance/ a trustable
+one-JVM-run validation path (BASELINE.md)."""
+
+import os
+
+def conformance_dir():
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", "conformance")
+
+
+def test_pack_matches_regeneration(tmp_path):
+    import importlib.util
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "make_conformance",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "make_conformance.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.generate(str(tmp_path))
+    packed = conformance_dir()
+    names = sorted(f for f in os.listdir(packed)
+                   if f.endswith((".jsonl", ".txt")))
+    assert names, "conformance pack is empty"
+    regen = sorted(f for f in os.listdir(str(tmp_path)))
+    assert names == regen
+    for f in names:
+        with open(os.path.join(packed, f), "rb") as a, \
+                open(os.path.join(str(tmp_path), f), "rb") as b:
+            assert a.read() == b.read(), f"{f} drifted from regeneration"
