@@ -1,6 +1,6 @@
 """kme_tpu — TPU-native matching-engine framework.
 
-A ground-up JAX/XLA/pjit re-design of the capabilities of the
+A ground-up JAX/XLA/Pallas/pjit re-design of the capabilities of the
 reference VD44/Kafka-Matching-Engine (a Kafka Streams limit-order-book
 processor, /root/reference/src/main/java/KProcessor.java): prediction-market
 style binary-outcome contracts, integer prices 0..125, margin `price` per

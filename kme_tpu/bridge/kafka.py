@@ -53,6 +53,7 @@ class KafkaBroker:
         self.bootstrap = bootstrap
         self._loop = asyncio.new_event_loop()
         self._producer = None
+        self._admin = None
         self._consumers: Dict[str, object] = {}
         self._positions: Dict[str, int] = {}
 
@@ -93,32 +94,29 @@ class KafkaBroker:
         return self._k.TopicPartition(topic, 0)
 
     # -------------------------------------------------- broker surface
+    def _get_admin(self):
+        if self._admin is None:
+            a = self._make(lambda: self._k.admin.AIOKafkaAdminClient(
+                bootstrap_servers=self.bootstrap))
+            self._run(a.start())
+            self._admin = a
+        return self._admin
+
     def create_topic(self, name: str, partitions: int = 1) -> bool:
         """kafkajs admin.createTopics semantics (topic.js:14-25):
         False when the topic already exists."""
-        admin = self._make(lambda: self._k.admin.AIOKafkaAdminClient(
-            bootstrap_servers=self.bootstrap))
-        self._run(admin.start())
-        try:
-            existing = self._run(admin.list_topics())
-            if name in existing:
-                return False
-            new = self._k.admin.NewTopic(
-                name=name, num_partitions=partitions, replication_factor=1)
-            self._run(admin.create_topics([new]))
-            return True
-        finally:
-            self._run(admin.close())
+        admin = self._get_admin()
+        existing = self._run(admin.list_topics())
+        if name in existing:
+            return False
+        new = self._k.admin.NewTopic(
+            name=name, num_partitions=partitions, replication_factor=1)
+        self._run(admin.create_topics([new]))
+        return True
 
     def topics(self) -> Dict[str, int]:
-        admin = self._make(lambda: self._k.admin.AIOKafkaAdminClient(
-            bootstrap_servers=self.bootstrap))
-        self._run(admin.start())
-        try:
-            return {t: 1 for t in self._run(admin.list_topics())
-                    if not t.startswith("__")}
-        finally:
-            self._run(admin.close())
+        return {t: 1 for t in self._run(self._get_admin().list_topics())
+                if not t.startswith("__")}
 
     def produce(self, topic: str, key: Optional[str], value: str) -> int:
         p = self._get_producer()
@@ -166,3 +164,6 @@ class KafkaBroker:
         if self._producer is not None:
             self._run(self._producer.stop())
             self._producer = None
+        if self._admin is not None:
+            self._run(self._admin.close())
+            self._admin = None

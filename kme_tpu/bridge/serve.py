@@ -24,11 +24,14 @@ def main(argv=None) -> int:
                         "live in Kafka (durable there), --listen/--log-dir "
                         "are ignored, and the reference's unmodified Node "
                         "harness can drive the engine")
-    p.add_argument("--engine", choices=("lanes", "oracle", "native"),
-                   default="lanes",
-                   help="lanes = device throughput engine (fixed mode); "
-                        "native = C++ quirk-exact engine (fast java "
-                        "compat); oracle = Python reference replica")
+    p.add_argument("--engine", choices=("seq", "lanes", "oracle",
+                                        "native"),
+                   default="seq",
+                   help="seq = sequential Pallas mega-kernel (fixed "
+                        "mode, the flagship); lanes = vectorized sweep "
+                        "engine (fixed mode, shardable); native = C++ "
+                        "quirk-exact engine (fast java compat); oracle "
+                        "= Python reference replica")
     p.add_argument("--compat", choices=("java", "fixed"), default="fixed")
     p.add_argument("--batch", type=int, default=1024,
                    help="max records per engine micro-batch")

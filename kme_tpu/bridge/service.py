@@ -41,10 +41,10 @@ class MatchService:
                  strict: bool = False,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 4096) -> None:
-        if engine not in ("lanes", "oracle", "native"):
+        if engine not in ("lanes", "seq", "oracle", "native"):
             raise ValueError(f"unknown engine {engine!r}")
-        if engine == "lanes" and compat != "fixed":
-            raise ValueError("the lanes engine is fixed-mode only; "
+        if engine in ("lanes", "seq") and compat != "fixed":
+            raise ValueError("the device engines are fixed-mode only; "
                              "use engine='oracle'/'native' for "
                              "compat='java'")
         self.broker = broker
@@ -70,6 +70,8 @@ class MatchService:
             cfg = LaneConfig(lanes=symbols, slots=slots, accounts=accounts,
                              max_fills=max_fills)
             self._session = LaneSession(cfg, shards=shards, width=width)
+        elif engine == "seq":
+            self._session = self._make_seq_session()
         elif engine == "native":
             from kme_tpu.native.oracle import NativeOracleEngine
 
@@ -92,11 +94,35 @@ class MatchService:
     # the MatchIn tail from the snapshot offset (at-least-once, like the
     # reference with exactly-once commented out — KProcessor.java:29)
 
+    def _make_seq_session(self):
+        from kme_tpu.engine import seq as SQ
+        from kme_tpu.runtime.seqsession import SeqSession
+
+        return SeqSession(self._seq_cfg())
+
+    def _seq_cfg(self):
+        from kme_tpu.engine import seq as SQ
+
+        slots = self._req_slots
+        if slots % 128 != 0:
+            raise ValueError(
+                f"the seq engine needs slots % 128 == 0, got {slots}")
+        return SQ.SeqConfig(
+            lanes=self._req_symbols, slots=slots,
+            accounts=-(-self._req_accounts // 128) * 128,
+            max_fills=self._req_max_fills, hbm_books=slots > 512)
+
     def _try_resume(self, engine: str, compat: str, shards: int,
                     width: int) -> bool:
         from kme_tpu.runtime import checkpoint as ck
 
-        if engine == "lanes":
+        if engine == "seq":
+            ses, offset = ck.load_seq_session(self.checkpoint_dir,
+                                              self._seq_cfg())
+            if ses is None:
+                return False
+            self._session = ses
+        elif engine == "lanes":
             # elastic restore onto the REQUESTED topology (snapshots are
             # canonical across shards/width)
             ses, offset = ck.load_session(self.checkpoint_dir,
@@ -174,7 +200,14 @@ class MatchService:
                       f"({e}); snapshot deferred", file=sys.stderr)
                 return
         if self._session is not None:
-            ck.save_session(self.checkpoint_dir, self._session, self.offset)
+            from kme_tpu.runtime.seqsession import SeqSession
+
+            if isinstance(self._session, SeqSession):
+                ck.save_seq_session(self.checkpoint_dir, self._session,
+                                    self.offset)
+            else:
+                ck.save_session(self.checkpoint_dir, self._session,
+                                self.offset)
         elif self._native is not None:
             ck.save_native(self.checkpoint_dir, self._native, self.offset)
         else:
@@ -287,16 +320,24 @@ class MatchService:
 
             def beater():
                 while not beat_stop.wait(health_every):
-                    state._write_heartbeat(health_file, seen_box[0])
+                    state._write_heartbeat(health_file, seen_box[0],
+                                           tick_box[0])
 
             seen_box = [0]
-            self._write_heartbeat(health_file, 0)
+            tick_box = [0]
+            self._write_heartbeat(health_file, 0, 0)
             t = threading.Thread(target=beater, daemon=True)
             t.start()
         try:
             idle_since = time.monotonic()
             while max_messages is None or seen < max_messages:
                 n = self.step(timeout=poll_timeout)
+                if beat_stop is not None:
+                    # the loop TICK advances every iteration, idle or
+                    # not — a frozen tick is the supervisor's hang
+                    # signal (the mtime alone only proves the beater
+                    # thread lives)
+                    tick_box[0] += 1
                 now = time.monotonic()
                 if n == 0:
                     if idle_exit is not None \
@@ -310,10 +351,11 @@ class MatchService:
         finally:
             if beat_stop is not None:
                 beat_stop.set()
-                self._write_heartbeat(health_file, seen)
+                self._write_heartbeat(health_file, seen, tick_box[0])
         return seen
 
-    def _write_heartbeat(self, path: str, seen: int) -> None:
+    def _write_heartbeat(self, path: str, seen: int,
+                         tick: int = 0) -> None:
         import json
         import os
         import time as _t
@@ -321,5 +363,6 @@ class MatchService:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"pid": os.getpid(), "time": _t.time(),
-                       "seen": seen, "offset": self.offset}, f)
+                       "seen": seen, "offset": self.offset,
+                       "tick": tick}, f)
         os.replace(tmp, path)

@@ -8,8 +8,12 @@ child with a heartbeat file (--health-file) and a checkpoint directory,
 and restarts the child from its newest checkpoint whenever
 
 - the child process exits with a non-zero status, or
-- the heartbeat goes STALE (mtime older than --stale-after seconds —
-  the liveness signal; a hung process is as dead as a crashed one).
+- the heartbeat goes STALE (mtime older than --stale-after seconds:
+  the process froze or died), or
+- the serve LOOP TICK in the heartbeat stops advancing for
+  --stall-after seconds (the loop iterates even when idle, so a frozen
+  tick means a hang inside step() — e.g. a stuck device call — even
+  while the heartbeat thread keeps the mtime fresh).
 
 Durability is the existing checkpoint/resume contract: broker topic
 logs persist under the checkpoint dir, the child resumes from the
@@ -41,9 +45,18 @@ def _hb_age(path: str) -> float:
         return float("inf")
 
 
+def _hb_tick(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f).get("tick")
+    except (OSError, ValueError):
+        return None
+
+
 def supervise(serve_args, checkpoint_dir: str, stale_after: float = 10.0,
               max_restarts: int = 5, grace: float = 5.0,
-              poll: float = 0.5, echo: bool = True) -> int:
+              poll: float = 0.5, echo: bool = True,
+              stall_after: float = 60.0) -> int:
     """Run kme-serve under supervision; returns the child's final rc.
 
     serve_args: argv tail passed to `kme-serve` verbatim (the supervisor
@@ -62,6 +75,7 @@ def supervise(serve_args, checkpoint_dir: str, stale_after: float = 10.0,
         child = subprocess.Popen(base)
         start = time.time()
         failed = None
+        last_tick, tick_since = None, time.time()
         while True:
             time.sleep(poll)
             if not _alive(child):
@@ -79,6 +93,13 @@ def supervise(serve_args, checkpoint_dir: str, stale_after: float = 10.0,
                 continue
             if age > stale_after:
                 failed = f"heartbeat stale ({age:.1f}s > {stale_after}s)"
+                break
+            tick = _hb_tick(hb)
+            if tick != last_tick:
+                last_tick, tick_since = tick, time.time()
+            elif time.time() - tick_since > stall_after:
+                failed = (f"serve loop stalled (tick {tick} frozen "
+                          f"{time.time() - tick_since:.0f}s)")
                 break
         if echo:
             print(f"kme-supervise: FAILURE DETECTED: {failed}",
@@ -100,7 +121,10 @@ def main(argv=None) -> int:
                    help="checkpoint + broker-log + heartbeat directory "
                         "(the restart state root)")
     p.add_argument("--stale-after", type=float, default=10.0,
-                   help="heartbeat age that counts as a hang")
+                   help="heartbeat age that counts as a frozen process")
+    p.add_argument("--stall-after", type=float, default=60.0,
+                   help="seconds without a loop-tick advance that count "
+                        "as a hang inside step()")
     p.add_argument("--max-restarts", type=int, default=5)
     p.add_argument("--grace", type=float, default=5.0,
                    help="startup seconds before the first heartbeat is due")
@@ -113,7 +137,8 @@ def main(argv=None) -> int:
     os.makedirs(args.checkpoint_dir, exist_ok=True)
     return supervise(serve_args, args.checkpoint_dir,
                      stale_after=args.stale_after,
-                     max_restarts=args.max_restarts, grace=args.grace)
+                     max_restarts=args.max_restarts, grace=args.grace,
+                     stall_after=args.stall_after)
 
 
 if __name__ == "__main__":

@@ -1100,12 +1100,31 @@ def export_canonical(cfg: SeqConfig, state) -> dict:
 
 
 def import_canonical(cfg: SeqConfig, canon: dict):
-    """Inverse of export_canonical (numpy -> device plane dict)."""
+    """Inverse of export_canonical (numpy -> device plane dict). The
+    snapshot's slot depth and account capacity may be SMALLER than the
+    config's (elastic restore into deeper books / wider account space —
+    position hash keys are recomputed with the new stride); shrinking
+    either is a state migration, not a restore, and raises."""
     S, N, A, NR = cfg.lanes, cfg.slots, cfg.accounts, cfg.nr
+    S0 = np.asarray(canon["slot_oid"]).shape[0]
+    if S0 != S:
+        raise ValueError(
+            f"snapshot has {S0} lanes, cfg.lanes={S} — lane-count "
+            f"changes need a state migration, not a restore")
+    N0 = np.asarray(canon["slot_oid"]).shape[2]
+    if N0 > N:
+        raise ValueError(
+            f"snapshot books are {N0} slots deep; cfg.slots={N} cannot "
+            f"hold them — restore into slots >= {N0}")
+    A0 = np.asarray(canon["pos_amt"]).reshape(-1).size // S
+    if A0 > A:
+        raise ValueError(
+            f"snapshot has {A0} account slots; cfg.accounts={A} cannot "
+            f"hold them — restore into accounts >= {A0}")
 
     def slot2planes(v, split=False):
         full = np.zeros((S, 2, NR * LN), np.int64)
-        full[:, :, :N] = np.asarray(v).reshape(S, 2, N)
+        full[:, :, :N0] = np.asarray(v).reshape(S, 2, N0)
         flat = full.reshape(2 * S * NR, LN)
         if split:
             lo = (flat & 0xFFFFFFFF).astype(np.uint32).astype(np.int32)
@@ -1122,9 +1141,15 @@ def import_canonical(cfg: SeqConfig, canon: dict):
         a[:len(v)] = v
         return a.reshape(rows, LN)
 
-    pos_amt = np.asarray(canon["pos_amt"]).reshape(-1)
-    pos_avail = np.asarray(canon["pos_avail"]).reshape(-1)
-    live = np.nonzero(pos_amt != 0)[0]
+    pos_amt = np.asarray(canon["pos_amt"]).reshape(S, A0)
+    pos_avail = np.asarray(canon["pos_avail"]).reshape(S, A0)
+    live2 = np.nonzero(pos_amt != 0)
+    # re-key (lane, acc) with the CONFIG's stride (A may exceed A0)
+    live = live2[0].astype(np.int64) * A + live2[1].astype(np.int64)
+    pos_amt = {int(k): int(pos_amt[l, a])
+               for k, l, a in zip(live, live2[0], live2[1])}
+    pos_avail = {int(k): int(pos_avail[l, a])
+                 for k, l, a in zip(live, live2[0], live2[1])}
     if len(live) > cfg.pos_cap // 2:
         raise ValueError(
             f"{len(live)} live positions exceed half the hash capacity "
@@ -1154,11 +1179,16 @@ def import_canonical(cfg: SeqConfig, canon: dict):
             empt = np.nonzero(row == 0)[0]
             if len(empt):
                 j = base + empt[0]
+                def _lo(v):
+                    lo = int(v) & 0xFFFFFFFF
+                    return np.int32(lo - (1 << 32) if lo >= (1 << 31)
+                                    else lo)
+
                 hk[j] = key
-                halo[j] = np.int32(pos_amt[k] & 0xFFFFFFFF)
-                hahi[j] = np.int32(pos_amt[k] >> 32)
-                hvlo[j] = np.int32(pos_avail[k] & 0xFFFFFFFF)
-                hvhi[j] = np.int32(pos_avail[k] >> 32)
+                halo[j] = _lo(pos_amt[int(k)])
+                hahi[j] = np.int32(int(pos_amt[int(k)]) >> 32)
+                hvlo[j] = _lo(pos_avail[int(k)])
+                hvhi[j] = np.int32(int(pos_avail[int(k)]) >> 32)
                 placed = True
                 break
             t = int(t) + 1

@@ -142,7 +142,10 @@ def _prune(ckpt_dir: str, pattern, keep: int = 2) -> None:
 def _load_file(path: str):
     data = np.load(path)
     meta = json.loads(bytes(data["meta"]).decode())
-    if meta.get("version") != 1 or meta.get("kind") != "lanes":
+    # "lanes" and "seq" snapshots share the canonical payload layout
+    # and restore into EITHER engine (cross-engine restore)
+    if meta.get("version") != 1 or meta.get("kind") not in ("lanes",
+                                                            "seq"):
         raise ValueError(f"unsupported snapshot {path}")
     return data, meta
 
@@ -175,7 +178,13 @@ def _restore_one(path: str, shards: Optional[int], width: Optional[int]):
     from kme_tpu.runtime.session import LaneSession
 
     data, meta = _load_file(path)
-    cfg = LaneConfig(**meta["cfg"])
+    if meta.get("kind") == "seq":  # cross-engine restore (canonical)
+        mc = meta["cfg"]
+        cfg = LaneConfig(lanes=int(mc["lanes"]), slots=int(mc["slots"]),
+                         accounts=int(mc["accounts"]),
+                         max_fills=int(mc["max_fills"]))
+    else:
+        cfg = LaneConfig(**meta["cfg"])
     use_shards = meta["shards"] if shards is None else shards
     use_width = meta["width"] if width is None else width
     ses = LaneSession(cfg, shards=use_shards, width=use_width or 0)
@@ -245,6 +254,107 @@ def _restore_one(path: str, shards: Optional[int], width: Optional[int]):
     sch.sid_lane = {int(k): int(l) for k, l in meta["sid_lane"]}
     sch.oid_sid = {int(k): int(s) for k, s in meta["oid_sid"]}
     sch._rr_lane = int(meta["rr_lane"])
+    return ses
+
+
+class SnapshotCapacityError(ValueError):
+    """The snapshot cannot restore into the requested capacity config
+    (a state migration, not a resume) — callers must NOT silently fall
+    back to a fresh engine."""
+
+
+def save_seq_session(ckpt_dir: str, session, offset: int) -> str:
+    """Snapshot a SeqSession at input offset `offset` in the SAME
+    canonical layout as lanes snapshots (slot_* / flat s64 positions /
+    bal), so snapshots restore across ENGINES as well as across
+    shard/width topologies."""
+    from kme_tpu.engine import seq as SQ
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    canon = SQ.export_canonical(session.cfg, session.state)
+    r = session.router
+    meta = {
+        "version": 1,
+        "kind": "seq",
+        "offset": int(offset),
+        "cfg": dataclasses.asdict(session.cfg),
+        "metrics": [int(x) for x in session._metrics],
+        "aid_idx": sorted(r.aid_idx.items()),
+        "sid_lane": sorted(r.sid_lane.items()),
+        "oid_sid": sorted(r.oid_sid.items()),
+        "rr_lane": 0,   # lanes-session cross-restore compatibility
+        "width": 0,
+        "shards": 1,
+    }
+    payload = {k: v for k, v in canon.items()
+               if k != "metrics" and v is not None}
+    payload["err"] = np.asarray(canon["err"])
+    # lanes-session cross-restore expects the drained fill-log cursor
+    payload["filloff"] = np.zeros(1, np.int64)
+    payload["meta"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    path = snapshot_path(ckpt_dir, offset)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(ckpt_dir)
+    _prune(ckpt_dir, _CKPT_RE)
+    return path
+
+
+def load_seq_session(ckpt_dir: str, cfg=None):
+    """Restore the newest valid snapshot into a SeqSession. `cfg` (a
+    SeqConfig) sets the RESTORE topology — snapshots are canonical, so
+    any slots >= the snapshot's depth works, and lanes-engine snapshots
+    restore here too (cross-engine). Returns (session, offset) or
+    (None, 0)."""
+    for offset, path in list_snapshots(ckpt_dir):
+        try:
+            return _restore_seq_one(path, cfg), offset
+        except SnapshotCapacityError:
+            raise          # operator error, not corruption: surface it
+        except Exception as e:
+            import sys
+
+            print(f"kme_tpu.checkpoint: skipping unreadable snapshot "
+                  f"{path}: {e}", file=sys.stderr)
+    return None, 0
+
+
+def _restore_seq_one(path: str, cfg):
+    from kme_tpu.engine import seq as SQ
+    from kme_tpu.runtime.seqsession import SeqSession
+
+    data, meta = _load_file(path)
+    if cfg is None:
+        if meta["kind"] == "seq":
+            cfg = SQ.SeqConfig(**meta["cfg"])
+        else:  # a lanes snapshot: map the shared capacity fields
+            mc = meta["cfg"]
+            slots = -(-int(mc["slots"]) // 128) * 128
+            cfg = SQ.SeqConfig(
+                lanes=int(mc["lanes"]), slots=slots,
+                accounts=-(-int(mc["accounts"]) // 128) * 128,
+                max_fills=int(mc["max_fills"]),
+                hbm_books=slots > 512)
+    canon = {k: np.asarray(data[k]) for k in data.files if k != "meta"}
+    canon.setdefault("err", np.int32(0))
+    ses = SeqSession(cfg)
+    try:
+        ses.state = SQ.import_canonical(cfg, canon)
+    except ValueError as e:
+        if "state migration" in str(e) or "restore into" in str(e):
+            raise SnapshotCapacityError(str(e)) from e
+        raise
+    if "metrics" in meta:
+        ses._metrics = np.asarray(meta["metrics"], np.int64)
+    r = ses.router
+    r.aid_idx = {int(k): int(i) for k, i in meta["aid_idx"]}
+    r.sid_lane = {int(k): int(l) for k, l in meta["sid_lane"]}
+    r.oid_sid = {int(k): int(s) for k, s in meta["oid_sid"]}
     return ses
 
 

@@ -242,3 +242,57 @@ def test_seq_hbm_books_parity():
     assert_seq_parity(msgs, SQ.SeqConfig(
         lanes=8, slots=256, accounts=128, max_fills=64, batch=256,
         pos_cap=1 << 11, fill_cap=1 << 13, probe_max=16, hbm_books=True))
+
+
+def test_seq_service_and_cross_engine_restore(tmp_path):
+    """MatchService with engine='seq': serve a stream byte-exact, crash
+    after a checkpoint, resume — and restore the SAME snapshot into the
+    LANES engine (snapshots are canonical across engines)."""
+    from kme_tpu.bridge.broker import InProcessBroker
+    from kme_tpu.bridge.provision import provision
+    from kme_tpu.bridge.service import MatchService
+    from kme_tpu.runtime import checkpoint as ck
+    from kme_tpu.wire import dumps_order
+
+    msgs = harness_stream(300, seed=13, num_symbols=4, num_accounts=8,
+                          payout_opcode_bug=False, validate=True)
+    ora = OracleEngine("fixed", book_slots=128, max_fills=32)
+    per_msg = [[r.wire() for r in ora.process(m.copy())] for m in msgs]
+
+    ck_dir = str(tmp_path / "ck")
+    kw = dict(engine="seq", compat="fixed", batch=50, symbols=8,
+              accounts=128, slots=128, max_fills=32,
+              checkpoint_dir=ck_dir, checkpoint_every=100)
+    b = InProcessBroker(persist_dir=str(tmp_path / "log"))
+    provision(b)
+    for m in msgs:
+        b.produce("MatchIn", None, dumps_order(m))
+    svc1 = MatchService(b, **kw)
+    assert svc1.run(max_messages=150) == 150   # snapshot at >=100
+    snap_off = svc1._last_ckpt_offset
+    assert snap_off >= 100
+    del svc1  # crash
+
+    svc2 = MatchService(b, **kw)               # resume (seq -> seq)
+    assert svc2.offset == snap_off
+    assert svc2.run(max_messages=len(msgs) - snap_off) \
+        == len(msgs) - snap_off
+    from kme_tpu.bridge.consume import consume_lines
+    got = list(consume_lines(b, follow=False))
+    want = [ln for lines in per_msg[:150] for ln in lines]
+    want += [ln for lines in per_msg[snap_off:] for ln in lines]
+    assert got == want
+
+    # cross-engine: the newest seq snapshot restores into a
+    # LaneSession; the restored canonical STATE must equal the
+    # oracle's stores exactly, and any remaining stream tail must
+    # replay byte-exact
+    ses, off = ck.load_session(ck_dir)
+    assert ses is not None and off >= snap_off
+    if off < len(msgs):
+        tail = ses.process_wire([m.copy() for m in msgs[off:]])
+        assert [ln for lines in tail for ln in lines] \
+            == [ln for lines in per_msg[off:] for ln in lines]
+    exp = ses.export_state()
+    assert exp["balances"] == dict(ora.balances)
+    assert exp["positions"] == dict(ora.positions)
