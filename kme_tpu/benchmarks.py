@@ -407,32 +407,51 @@ def bench_latency(events: int = 20_000, symbols: int = 1024,
                   accounts: int = 2048, seed: int = 0, zipf_a: float = 1.2,
                   slots: int = 128, max_fills: int = 16,
                   width: int = DEFAULT_WIDTH, shards: int = 1,
-                  batch: int = DEFAULT_LATENCY_BATCH) -> dict:
+                  batch: int = DEFAULT_LATENCY_BATCH,
+                  engine: str = "seq") -> dict:
     """Streaming latency (BASELINE.md p99 column): the stream is served
     in micro-batches of `batch` messages through process_wire; a
     message's fill latency is bounded by its batch's wall time, so the
-    per-batch wall distribution IS the latency envelope.
+    per-batch wall distribution IS the latency envelope. engine='seq'
+    (default) serves each micro-batch as ONE kernel dispatch + one
+    fetch round; 'sweep' is the round-3 lanes path.
 
     Caveat on this driver's numbers: the TPU sits behind a tunnel with
-    ~100ms round trips, and a batch pays 2-3 of them (dispatch, output
-    fetch, fill-log fetch) — the measured floor is transport latency,
-    not engine time (the same batches cost ~10ms of device+host work
-    on locally attached hardware per the phase timings)."""
+    ~100ms round trips — the measured floor is transport latency, not
+    engine time (the same batches cost ~10ms of device+host work on
+    locally attached hardware per the phase timings)."""
     import jax
 
-    from kme_tpu.engine.lanes import LaneConfig
-    from kme_tpu.runtime.session import LaneSession
     from kme_tpu.workload import zipf_symbol_stream
 
-    cfg = LaneConfig(lanes=symbols, slots=slots, accounts=accounts,
-                     max_fills=max_fills)
     msgs = zipf_symbol_stream(events, num_symbols=symbols,
                               num_accounts=accounts, seed=seed,
                               zipf_a=zipf_a)
-    warm = LaneSession(cfg, shards=shards, width=width)  # compile buckets
+
+    if engine == "seq":
+        from kme_tpu.engine import seq as SQ
+        from kme_tpu.runtime.seqsession import SeqSession
+
+        # the seq kernel's plane layout needs 128-multiples; the
+        # EFFECTIVE envelope is reported in the detail dict
+        slots = -(-max(slots, 128) // 128) * 128
+        accounts = -(-accounts // 128) * 128
+        scfg = SQ.SeqConfig(
+            lanes=symbols, slots=slots, accounts=accounts,
+            max_fills=max_fills, hbm_books=slots > 512,
+            batch=max(128, min(4096, 1 << (batch - 1).bit_length())))
+        mk = lambda: SeqSession(scfg)
+    else:
+        from kme_tpu.engine.lanes import LaneConfig
+        from kme_tpu.runtime.session import LaneSession
+
+        cfg = LaneConfig(lanes=symbols, slots=slots, accounts=accounts,
+                         max_fills=max_fills)
+        mk = lambda: LaneSession(cfg, shards=shards, width=width)
+    warm = mk()  # compile every shape bucket
     for lo in range(0, len(msgs), batch):
         warm.process_wire(msgs[lo:lo + batch])
-    ses = LaneSession(cfg, shards=shards, width=width)
+    ses = mk()
     walls = []
     t_all = time.perf_counter()
     for lo in range(0, len(msgs), batch):
@@ -457,8 +476,12 @@ def bench_latency(events: int = 20_000, symbols: int = 1024,
         "unit": "ms",
         "vs_baseline": round((len(msgs) / t_all) / REFERENCE_BASELINE_OPS, 3),
         "detail": {
-            "events": len(msgs), "batch": batch, "width": width,
-            "shards": shards,
+            "events": len(msgs), "batch": batch, "engine": engine,
+            "slots": slots,
+            # topology flags only apply to the sweep engine; the seq
+            # path is single-device with no compaction width
+            "width": width if engine != "seq" else 0,
+            "shards": shards if engine != "seq" else 1,
             "p50_ms": round(pct(0.50) * 1e3, 2),
             "p90_ms": round(pct(0.90) * 1e3, 2),
             "p99_ms": round(pct(0.99) * 1e3, 2),
@@ -536,7 +559,7 @@ def main(argv=None) -> int:
                             slots=args.slots or 128,
                             max_fills=args.max_fills,
                             width=args.width, shards=args.shards,
-                            batch=args.batch)
+                            batch=args.batch, engine=args.engine)
     else:
         rec = bench_parity_engine(args.events or 4096, args.seed, args.batch,
                                   args.compat)

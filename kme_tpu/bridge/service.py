@@ -95,7 +95,6 @@ class MatchService:
     # reference with exactly-once commented out — KProcessor.java:29)
 
     def _make_seq_session(self):
-        from kme_tpu.engine import seq as SQ
         from kme_tpu.runtime.seqsession import SeqSession
 
         return SeqSession(self._seq_cfg())

@@ -56,7 +56,7 @@ def _hb_tick(path: str):
 def supervise(serve_args, checkpoint_dir: str, stale_after: float = 10.0,
               max_restarts: int = 5, grace: float = 5.0,
               poll: float = 0.5, echo: bool = True,
-              stall_after: float = 60.0) -> int:
+              stall_after: float = 300.0) -> int:
     """Run kme-serve under supervision; returns the child's final rc.
 
     serve_args: argv tail passed to `kme-serve` verbatim (the supervisor
@@ -75,7 +75,11 @@ def supervise(serve_args, checkpoint_dir: str, stale_after: float = 10.0,
         child = subprocess.Popen(base)
         start = time.time()
         failed = None
-        last_tick, tick_since = None, time.time()
+        # stall detection ARMS only once the loop has ticked at least
+        # once: a first batch can legitimately sit in an XLA/Pallas
+        # compile for minutes before the first step() returns, and
+        # killing it mid-compile would loop forever
+        last_tick, tick_since, armed = None, time.time(), False
         while True:
             time.sleep(poll)
             if not _alive(child):
@@ -96,8 +100,10 @@ def supervise(serve_args, checkpoint_dir: str, stale_after: float = 10.0,
                 break
             tick = _hb_tick(hb)
             if tick != last_tick:
+                if last_tick is not None:
+                    armed = True
                 last_tick, tick_since = tick, time.time()
-            elif time.time() - tick_since > stall_after:
+            elif armed and time.time() - tick_since > stall_after:
                 failed = (f"serve loop stalled (tick {tick} frozen "
                           f"{time.time() - tick_since:.0f}s)")
                 break
@@ -122,7 +128,7 @@ def main(argv=None) -> int:
                         "(the restart state root)")
     p.add_argument("--stale-after", type=float, default=10.0,
                    help="heartbeat age that counts as a frozen process")
-    p.add_argument("--stall-after", type=float, default=60.0,
+    p.add_argument("--stall-after", type=float, default=300.0,
                    help="seconds without a loop-tick advance that count "
                         "as a hang inside step()")
     p.add_argument("--max-restarts", type=int, default=5)

@@ -149,6 +149,29 @@ struct Engine {
     }
   }
   std::unordered_map<int64_t, StoredOrder> orders;
+  // resting-order count per (sid, action) — maintained incrementally at
+  // every orders-map insert/erase of a DISTINCT record. Powers (a) the
+  // envelope's O(1) book_slots check and (b) the necessary-condition
+  // gate that makes the per-trade store snapshot RARE (copying five
+  // stores per trade is O(open_orders) and explodes on deep books).
+  std::unordered_map<std::pair<int64_t, int64_t>, int64_t, PairHash>
+      side_cnt;
+
+  void cnt_add(const StoredOrder& r, int64_t d) {
+    auto key = std::make_pair(r.sid, r.action);
+    auto it = side_cnt.find(key);
+    if (it == side_cnt.end()) {
+      if (d > 0) side_cnt.emplace(key, d);
+    } else {
+      it->second += d;
+      if (it->second <= 0) side_cnt.erase(it);
+    }
+  }
+
+  int64_t cnt_get(int64_t sid, int64_t action) const {
+    auto it = side_cnt.find(std::make_pair(sid, action));
+    return it == side_cnt.end() ? 0 : it->second;
+  }
   std::unordered_map<int64_t, Book> books;
   std::unordered_map<int64_t, Bucket> buckets;
 
@@ -258,6 +281,7 @@ struct Engine {
         if (oit == orders.end())
           throw Death{ERR_CRASH, "NPE: linked order missing in wipe"};
         StoredOrder rec = oit->second;
+        cnt_add(rec, -1);
         orders.erase(oit);
         post_remove_adjustments(rec);
         has = rec.next_has;
@@ -446,7 +470,13 @@ struct Engine {
       cur.size = jint((int64_t)cur.size - trade_size);
       execute_trade(maker, trade_size, taker_is_buy);
       if (maker.size != 0) break;
-      orders.erase(maker.oid);  // no-op when absent (RocksDB delete)
+      {
+        auto mit = orders.find(maker.oid);
+        if (mit != orders.end()) {
+          cnt_add(mit->second, -1);
+          orders.erase(mit);  // no-op when absent (RocksDB delete)
+        }
+      }
       if (!maker.next_has) {
         buckets.erase(bk);
         bitmap = with_bit_unset(bitmap, maker.price);
@@ -521,6 +551,11 @@ struct Engine {
     rec.next_has = cur.next_has;
     rec.prev = cur.prev;
     rec.prev_has = cur.prev_has;
+    {
+      auto old = orders.find(oid);
+      if (old != orders.end()) cnt_add(old->second, -1);
+    }
+    cnt_add(rec, +1);
     orders[oid] = rec;
     return true;
   }
@@ -576,6 +611,7 @@ struct Engine {
       orders[rec.prev] = prv;
       orders[rec.next] = nxt;
     }
+    cnt_add(rec, -1);
     orders.erase(oid);
     post_remove_adjustments(rec);
     return true;
@@ -610,7 +646,24 @@ struct Engine {
       process_one();
       return;
     }
+    // NECESSARY conditions for a violation, checkable in O(1) before
+    // executing: (a) sweeping > max_fills makers needs > max_fills
+    // resting on the opposite side; (b) exceeding book_slots after a
+    // rest needs the side already AT >= book_slots. When neither holds
+    // the snapshot (a full copy of five stores, O(open_orders)) is
+    // skipped — the common case on deep books.
+    int64_t opp_act = cur.action == OP_BUY ? OP_SELL : OP_BUY;
+    bool possible = false;
+    if (has_max_fills && cnt_get(cur.sid, opp_act) > max_fills)
+      possible = true;
+    if (has_book_slots && cnt_get(cur.sid, cur.action) >= book_slots)
+      possible = true;
+    if (!possible) {
+      process_one();
+      return;
+    }
     Echo orig = cur;
+    auto s_cnt = side_cnt;
     uint64_t s_seq = pos_seq;
     auto s_bal = balances;
     auto s_pos = positions;
@@ -631,15 +684,11 @@ struct Engine {
     if (!violated && has_book_slots) {
       auto rit = orders.find(orig.oid);
       if (rit != orders.end() && rit->second.sid == orig.sid &&
-          rit->second.action == orig.action) {
-        int64_t n_side = 0;
-        for (auto& kv : orders)
-          if (kv.second.sid == orig.sid && kv.second.action == orig.action)
-            n_side += 1;
-        violated = n_side > book_slots;
-      }
+          rit->second.action == orig.action)
+        violated = cnt_get(orig.sid, orig.action) > book_slots;
     }
     if (!violated) return;
+    side_cnt = std::move(s_cnt);
     pos_seq = s_seq;
     balances = std::move(s_bal);
     positions = std::move(s_pos);
@@ -766,6 +815,7 @@ int32_t kme_oracle_load_state(Engine* e, const char* text) {
   e->orders.clear();
   e->books.clear();
   e->buckets.clear();
+  e->side_cnt.clear();  // rebuilt by the 'O' lines below
   e->pos_seq = 0;
   const char* p = text;
   while (*p) {
@@ -816,6 +866,11 @@ int32_t kme_oracle_load_state(Engine* e, const char* text) {
         r.next = g;
         r.prev_has = ph != 0;
         r.prev = prv2;
+        {
+          auto old = e->orders.find(oid2);
+          if (old != e->orders.end()) e->cnt_add(old->second, -1);
+        }
+        e->cnt_add(r, +1);
         e->orders[oid2] = r;
         break;
       }

@@ -329,6 +329,7 @@ def _restore_seq_one(path: str, cfg):
     from kme_tpu.runtime.seqsession import SeqSession
 
     data, meta = _load_file(path)
+    explicit_cfg = cfg is not None
     if cfg is None:
         if meta["kind"] == "seq":
             cfg = SQ.SeqConfig(**meta["cfg"])
@@ -342,13 +343,27 @@ def _restore_seq_one(path: str, cfg):
                 hbm_books=slots > 512)
     canon = {k: np.asarray(data[k]) for k in data.files if k != "meta"}
     canon.setdefault("err", np.int32(0))
+    if explicit_cfg:
+        # service resume: the matching ENVELOPE must not change across
+        # a resume (the lanes/native paths enforce the same; deeper
+        # books or a different max_fills alter reject behavior
+        # mid-stream — that is a state migration, not a resume)
+        n0 = int(np.asarray(canon["slot_oid"]).shape[2])
+        mf = int(meta["cfg"].get("max_fills", cfg.max_fills))
+        if cfg.slots != n0 or cfg.max_fills != mf:
+            raise SnapshotCapacityError(
+                f"snapshot envelope (slots={n0}, max_fills={mf}) != "
+                f"requested (slots={cfg.slots}, max_fills="
+                f"{cfg.max_fills}) — capacity changes need a state "
+                f"migration, not a resume")
     ses = SeqSession(cfg)
     try:
+        # every ValueError here is a config-vs-snapshot mismatch
+        # (corruption surfaces earlier, in _load_file) — never treat it
+        # as a skippable corrupt snapshot
         ses.state = SQ.import_canonical(cfg, canon)
     except ValueError as e:
-        if "state migration" in str(e) or "restore into" in str(e):
-            raise SnapshotCapacityError(str(e)) from e
-        raise
+        raise SnapshotCapacityError(str(e)) from e
     if "metrics" in meta:
         ses._metrics = np.asarray(meta["metrics"], np.int64)
     r = ses.router

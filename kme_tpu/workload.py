@@ -28,6 +28,7 @@ Faithful details:
 
 from __future__ import annotations
 
+import bisect
 import math
 import random
 from typing import Iterator, List, Optional
@@ -57,6 +58,12 @@ class WorkloadGen:
         # (price 0..125, size >= 1) for clean-semantics workloads.
         self.validate = validate
         self.open_orders: dict[int, int] = {}  # oid -> aid (exchange_test.js:21)
+        # sorted oid pool kept in lockstep with open_orders: cancels
+        # select by SORTED position, and re-sorting the whole pool per
+        # cancel is O(n^2 log n) over a long stream (the 400k soak spent
+        # >20 min of host CPU there). bisect keeps the identical order
+        # at O(n) memmove per op — the generated streams are UNCHANGED.
+        self._pool: list[int] = []
 
     # -- primitive distributions (exchange_test.js:48-61) --
 
@@ -100,12 +107,16 @@ class WorkloadGen:
 
     def create_buy(self, aid: int, sid: int, price: int, size: int) -> OrderMsg:
         oid = math.floor(self.rng.random() * (2 ** 53 - 1))
+        if oid not in self.open_orders:
+            bisect.insort(self._pool, oid)
         self.open_orders[oid] = aid
         return OrderMsg(action=op.BUY, oid=oid, aid=aid, sid=sid,
                         price=self._clamp_price(price), size=self._clamp_size(size))
 
     def create_sell(self, aid: int, sid: int, price: int, size: int) -> OrderMsg:
         oid = math.floor(self.rng.random() * (2 ** 53 - 1))
+        if oid not in self.open_orders:
+            bisect.insort(self._pool, oid)
         self.open_orders[oid] = aid
         return OrderMsg(action=op.SELL, oid=oid, aid=aid, sid=sid,
                         price=self._clamp_price(price), size=self._clamp_size(size))
@@ -113,8 +124,10 @@ class WorkloadGen:
     def create_cancel(self) -> OrderMsg:
         if not self.open_orders:
             return OrderMsg(action=op.CANCEL)
-        keys = sorted(self.open_orders)  # stable pool ordering under seed
-        oid = keys[math.floor(self.rng.random() * len(keys))]
+        # stable pool ordering under seed (identical to sorting the
+        # dict keys per call — _pool IS that sorted sequence)
+        i = math.floor(self.rng.random() * len(self._pool))
+        oid = self._pool.pop(i)
         aid = self.open_orders.pop(oid)
         return OrderMsg(action=op.CANCEL, oid=oid, aid=aid)
 
