@@ -20,7 +20,8 @@ from typing import Optional
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRCS = (os.path.join(_HERE, "kme_host.cpp"),
          os.path.join(_HERE, "kme_oracle.cpp"),
-         os.path.join(_HERE, "kme_wire.cpp"))
+         os.path.join(_HERE, "kme_wire.cpp"),
+         os.path.join(_HERE, "kme_router.cpp"))
 
 _lib = None
 _lib_tried = False
@@ -146,6 +147,34 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "kme_oracle_n_processed": ([c.c_void_p], c.c_int64),
         "kme_oracle_dump_state": ([c.c_void_p], c.c_char_p),
         "kme_oracle_load_state": ([c.c_void_p, c.c_char_p], c.c_int32),
+        # native seq router (kme_router.cpp)
+        "kme_router_new": ([c.c_int64, c.c_int64], c.c_void_p),
+        "kme_router_free": ([c.c_void_p], None),
+        "kme_router_route": ([c.c_void_p, c.c_int64] + [P64] * 6,
+                             c.c_int32),
+        "kme_router_n_routed": ([c.c_void_p], c.c_int64),
+        "kme_router_n_rejects": ([c.c_void_p], c.c_int64),
+        "kme_router_err_value": ([c.c_void_p], c.c_int64),
+        "kme_router_o_msg": ([c.c_void_p], P64),
+        "kme_router_o_oid": ([c.c_void_p], P64),
+        "kme_router_o_act": ([c.c_void_p], P32),
+        "kme_router_o_aidx": ([c.c_void_p], P32),
+        "kme_router_o_price": ([c.c_void_p], P32),
+        "kme_router_o_size": ([c.c_void_p], P32),
+        "kme_router_o_lane": ([c.c_void_p], P32),
+        "kme_router_o_rej": ([c.c_void_p], P64),
+        "kme_router_n_accounts": ([c.c_void_p], c.c_int64),
+        "kme_router_n_symbols": ([c.c_void_p], c.c_int64),
+        "kme_router_n_routes": ([c.c_void_p], c.c_int64),
+        "kme_router_export_accounts": ([c.c_void_p, P64, P32], None),
+        "kme_router_export_symbols": ([c.c_void_p, P64, P32], None),
+        "kme_router_export_routes": ([c.c_void_p, P64, P64], None),
+        "kme_router_import_accounts": ([c.c_void_p, c.c_int64, P64, P32],
+                                       None),
+        "kme_router_import_symbols": ([c.c_void_p, c.c_int64, P64, P32],
+                                      None),
+        "kme_router_import_routes": ([c.c_void_p, c.c_int64, P64, P64],
+                                     None),
         # native wire reconstruction (kme_wire.cpp)
         "kme_recon_new": ([], c.c_void_p),
         "kme_recon_free": ([c.c_void_p], None),

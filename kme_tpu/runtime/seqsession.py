@@ -150,6 +150,168 @@ class SeqRouter:
         return out, host_rejects
 
 
+class NativeSeqRouter:
+    """C++ twin of SeqRouter (native/kme_router.cpp): identical routing
+    over columnar int64 arrays. The id maps live in C++; the dict
+    properties export/import them for the checkpoint contract. A CALL
+    whose fields overflow int64 routes through a temporary Python
+    router (maps synced both ways); subsequent calls are native
+    again."""
+
+    def __init__(self, num_lanes: int, num_accounts: int, lib) -> None:
+        import weakref
+
+        self.S = num_lanes
+        self.A = num_accounts
+        self._lib = lib
+        self._h = lib.kme_router_new(num_lanes, num_accounts)
+        self._fin = weakref.finalize(self, lib.kme_router_free, self._h)
+
+    # -- map views (checkpoint save/load reads+writes these) -----------
+    def _export(self, nfn, efn, vdt):
+        import ctypes
+
+        n = nfn(self._h)
+        keys = np.empty(n, np.int64)
+        vals = np.empty(n, vdt)
+        P64 = ctypes.POINTER(ctypes.c_int64)
+        PV = ctypes.POINTER(
+            ctypes.c_int32 if vdt == np.int32 else ctypes.c_int64)
+        efn(self._h, keys.ctypes.data_as(P64), vals.ctypes.data_as(PV))
+        return dict(zip(keys.tolist(), vals.tolist()))
+
+    def _import(self, ifn, d, vdt):
+        import ctypes
+
+        keys = np.fromiter(d.keys(), np.int64, len(d))
+        vals = np.fromiter(d.values(), vdt, len(d))
+        P64 = ctypes.POINTER(ctypes.c_int64)
+        PV = ctypes.POINTER(
+            ctypes.c_int32 if vdt == np.int32 else ctypes.c_int64)
+        ifn(self._h, len(d), keys.ctypes.data_as(P64),
+            vals.ctypes.data_as(PV))
+
+    @property
+    def aid_idx(self):
+        lib = self._lib
+        return self._export(lib.kme_router_n_accounts,
+                            lib.kme_router_export_accounts, np.int32)
+
+    @aid_idx.setter
+    def aid_idx(self, d):
+        self._import(self._lib.kme_router_import_accounts, d, np.int32)
+
+    @property
+    def sid_lane(self):
+        lib = self._lib
+        return self._export(lib.kme_router_n_symbols,
+                            lib.kme_router_export_symbols, np.int32)
+
+    @sid_lane.setter
+    def sid_lane(self, d):
+        self._import(self._lib.kme_router_import_symbols, d, np.int32)
+
+    @property
+    def oid_sid(self):
+        lib = self._lib
+        return self._export(lib.kme_router_n_routes,
+                            lib.kme_router_export_routes, np.int64)
+
+    @oid_sid.setter
+    def oid_sid(self, d):
+        self._import(self._lib.kme_router_import_routes, d, np.int64)
+
+    def acct_of_idx(self) -> List[int]:
+        m = self.aid_idx
+        out = [0] * len(m)
+        for aid, idx in m.items():
+            out[idx] = aid
+        return out
+
+    def sid_of_lane(self) -> Dict[int, int]:
+        return {lane: sid for sid, lane in self.sid_lane.items()}
+
+    def route(self, msgs: Sequence[OrderMsg]):
+        import ctypes
+
+        n = len(msgs)
+        try:
+            raw = {
+                "action": np.fromiter((m.action for m in msgs),
+                                      np.int64, n),
+                "oid": np.fromiter((m.oid for m in msgs), np.int64, n),
+                "aid": np.fromiter((m.aid for m in msgs), np.int64, n),
+                "sid": np.fromiter((m.sid for m in msgs), np.int64, n),
+                "price": np.fromiter((m.price for m in msgs),
+                                     np.int64, n),
+                "size": np.fromiter((m.size for m in msgs), np.int64, n),
+            }
+        except OverflowError:
+            # a field beyond int64: the columnar path cannot carry it
+            py = SeqRouter(self.S, self.A)
+            py.aid_idx = self.aid_idx
+            py.sid_lane = self.sid_lane
+            py.oid_sid = self.oid_sid
+            cols, rejects = py.route(msgs)
+            self.aid_idx = py.aid_idx
+            self.sid_lane = py.sid_lane
+            self.oid_sid = py.oid_sid
+            return cols, rejects
+        bad = ((raw["price"] < -(2**31)) | (raw["price"] >= 2**31)
+               | (raw["size"] < -(2**31)) | (raw["size"] >= 2**31))
+        if bad.any():
+            i = int(np.argmax(bad))
+            raise EnvelopeError(
+                f"message {i}: price/size outside int32 "
+                f"(price={msgs[i].price}, size={msgs[i].size})")
+        lib = self._lib
+        P64 = ctypes.POINTER(ctypes.c_int64)
+        rc = lib.kme_router_route(
+            self._h, n, *(raw[f].ctypes.data_as(P64)
+                          for f in ("action", "oid", "aid", "sid",
+                                    "price", "size")))
+        if rc != 0:
+            raise CapacityError(
+                f"{'account' if rc == 1 else 'symbol'} capacity "
+                f"exhausted (id={lib.kme_router_err_value(self._h)})")
+        nr = lib.kme_router_n_routed(self._h)
+        nj = lib.kme_router_n_rejects(self._h)
+
+        from kme_tpu.native.sched import _arr
+
+        arr = lambda fn, dt, cnt: _arr(fn(self._h), cnt, dt)
+
+        cols = {
+            "msg_index": arr(lib.kme_router_o_msg, np.int64, nr),
+            "act": arr(lib.kme_router_o_act, np.int32, nr),
+            "aid": arr(lib.kme_router_o_aidx, np.int32, nr),
+            "price": arr(lib.kme_router_o_price, np.int32, nr),
+            "size": arr(lib.kme_router_o_size, np.int32, nr),
+            "lane": arr(lib.kme_router_o_lane, np.int32, nr),
+            "oid": arr(lib.kme_router_o_oid, np.int64, nr),
+        }
+        rejects = set(arr(lib.kme_router_o_rej, np.int64, nj).tolist())
+        return cols, rejects
+
+
+def make_seq_router(num_lanes: int, num_accounts: int):
+    """The native router when the toolchain/library is available
+    (KME_NATIVE=0 disables), else the Python implementation — identical
+    routing either way (tests/test_seq_engine.py)."""
+    try:
+        from kme_tpu.native import load_library
+
+        lib = load_library()
+        if lib is not None:
+            return NativeSeqRouter(num_lanes, num_accounts, lib)
+    except Exception as e:  # pragma: no cover - defensive fallback
+        import sys
+
+        print(f"kme_tpu: native seq router unavailable ({e}); "
+              f"using the Python fallback", file=sys.stderr)
+    return SeqRouter(num_lanes, num_accounts)
+
+
 class SeqSession:
     """Drop-in fixed-mode engine over the sequential mega-kernel.
 
@@ -160,7 +322,7 @@ class SeqSession:
     def __init__(self, cfg: SQ.SeqConfig) -> None:
         self.cfg = cfg
         self.state = SQ.make_seq_state(cfg)
-        self.router = SeqRouter(cfg.lanes, cfg.accounts)
+        self.router = make_seq_router(cfg.lanes, cfg.accounts)
         self._metrics = np.zeros(SQ.N_METRICS, np.int64)
         self._recon = None          # native reconstructor handle
         self.phases = {}            # wall time per phase of the last run

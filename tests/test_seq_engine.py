@@ -296,3 +296,37 @@ def test_seq_service_and_cross_engine_restore(tmp_path):
     exp = ses.export_state()
     assert exp["balances"] == dict(ora.balances)
     assert exp["positions"] == dict(ora.positions)
+
+
+def test_native_router_matches_python():
+    """The C++ router must produce identical plans and id maps to the
+    Python SeqRouter on a stream exercising every edge (unknown-oid
+    cancels, negative-sid addsym, payout route cleanup, re-used oids)."""
+    from kme_tpu.runtime.seqsession import (NativeSeqRouter, SeqRouter,
+                                            make_seq_router)
+
+    nat = make_seq_router(16, 256)
+    if not isinstance(nat, NativeSeqRouter):
+        pytest.skip("native library unavailable")
+    py = SeqRouter(16, 256)
+    msgs = harness_stream(1200, seed=21, num_symbols=6, num_accounts=12,
+                          payout_opcode_bug=False, validate=False)
+    INT64_MIN = -(1 << 63)
+    msgs += [
+        # negative-sid trade (allocates a negative map key), then the
+        # INT64_MIN payout/remove edge (abs wraps; must host-reject)
+        OrderMsg(action=op.BUY, oid=999001, aid=1, sid=-7, price=50,
+                 size=1),
+        OrderMsg(action=op.PAYOUT, sid=INT64_MIN, size=97),
+        OrderMsg(action=op.REMOVE_SYMBOL, sid=INT64_MIN),
+        OrderMsg(action=op.PAYOUT, sid=-7, size=97),
+    ]
+    for chunk in (msgs[:500], msgs[500:]):   # maps persist across calls
+        cn, rn = nat.route(chunk)
+        cp, rp = py.route(chunk)
+        assert rn == rp
+        for k in cp:
+            assert cn[k].tolist() == cp[k].tolist(), k
+    assert nat.aid_idx == py.aid_idx
+    assert nat.sid_lane == py.sid_lane
+    assert nat.oid_sid == py.oid_sid
