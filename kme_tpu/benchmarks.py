@@ -85,7 +85,8 @@ def bench_seq_engine(events: int = 100_000, symbols: int = 1024,
                      zipf_a: float = 1.2, slots: int = SEQ_DEFAULT_SLOTS,
                      max_fills: int = 16, batch: int = 4096,
                      parity_prefix: int = 20000,
-                     workload: str = "zipf") -> dict:
+                     workload: str = "zipf",
+                     compat: str = "fixed") -> dict:
     """End-to-end throughput of the SEQUENTIAL MEGA-KERNEL engine
     (kme_tpu/engine/seq.py) on the headline row: route + one scan
     dispatch + one-round fetch + native C++ wire reconstruction, with
@@ -101,19 +102,37 @@ def bench_seq_engine(events: int = 100_000, symbols: int = 1024,
 
     # books deeper than VMEM affords live in HBM behind the kernel's
     # per-lane scratch cache (SeqConfig.hbm_books)
-    cfg = SQ.SeqConfig(lanes=symbols, slots=slots, accounts=accounts,
-                       max_fills=max_fills, batch=batch,
-                       hbm_books=slots > 512)
-    if workload == "cancel":
+    if compat == "java":
+        # quirk-exact java mode ON the kernel: the STOCK harness shape
+        # (10 accounts, 3 symbols, Q5 payouts-as-cancels, sid=0
+        # trading); unbounded reference stores need deep device
+        # capacity (max_fills rides one (1,128) row, E <= 128)
+        symbols, accounts = 8, 128
+        max_fills = 128
+        workload = "harness"
+        cfg = SQ.SeqConfig(lanes=symbols, slots=max(slots, 8192),
+                           accounts=accounts, max_fills=max_fills,
+                           batch=batch, pos_cap=1 << 17,
+                           probe_max=64, compat="java", hbm_books=True)
+    else:
+        cfg = SQ.SeqConfig(lanes=symbols, slots=slots, accounts=accounts,
+                           max_fills=max_fills, batch=batch,
+                           hbm_books=slots > 512)
+    if workload == "harness":
+        from kme_tpu.workload import harness_stream
+
+        msgs = harness_stream(events, seed=seed)
+    elif workload == "cancel":
         msgs = cancel_heavy_stream(events, num_symbols=symbols,
                                    num_accounts=accounts, seed=seed)
     else:
         msgs = zipf_symbol_stream(events, num_symbols=symbols,
                                   num_accounts=accounts, seed=seed,
                                   zipf_a=zipf_a)
-    preamble = 2 * accounts + symbols
+    preamble = (23 if compat == "java"
+                else 2 * accounts + symbols)  # stock harness preamble
     prefix = min(preamble + parity_prefix, len(msgs))
-    _assert_seq_parity_prefix(msgs, cfg, prefix)
+    _assert_seq_parity_prefix(msgs, cfg, prefix, compat)
 
     warm = SeqSession(cfg)          # warmup: compile + shapes
     native_ok = warm.process_wire_buffer(msgs) is not None
@@ -144,12 +163,14 @@ def bench_seq_engine(events: int = 100_000, symbols: int = 1024,
     n = len(msgs)
     ops = n / total
     return {
-        "metric": "orders_per_sec_e2e",
+        "metric": ("orders_per_sec_java_exact_tpu" if compat == "java"
+                   else "orders_per_sec_e2e"),
         "value": round(ops, 1),
         "unit": "orders/s",
         "vs_baseline": round(ops / REFERENCE_BASELINE_OPS, 3),
         "detail": {
             "engine": "seq (sequential Pallas mega-kernel)",
+            "compat": compat,
             "events": n, "symbols": symbols, "accounts": accounts,
             "workload": workload, "zipf_a": zipf_a, "slots": slots,
             "max_fills": max_fills, "batch": batch,
@@ -176,15 +197,34 @@ def bench_seq_engine(events: int = 100_000, symbols: int = 1024,
     }
 
 
-def _assert_seq_parity_prefix(msgs, cfg, prefix: int) -> None:
+def _assert_seq_parity_prefix(msgs, cfg, prefix: int,
+                              compat: str = "fixed") -> None:
     """Replay `prefix` messages through a throwaway SeqSession and the
     quirk-exact replica; require byte-identical wire streams (the same
-    judge discipline as the lanes bench)."""
+    judge discipline as the lanes bench). compat='java' judges against
+    the JAVA-mode replica (no envelope — reference stores are
+    unbounded)."""
     from kme_tpu.runtime.seqsession import SeqSession
 
     ses = SeqSession(cfg)
-    want = _judge_wire(msgs, prefix,
-                       dict(book_slots=cfg.slots, max_fills=cfg.max_fills))
+    if compat == "java":
+        from kme_tpu.native.oracle import NativeOracleEngine, native_available
+
+        if native_available():
+            judge = NativeOracleEngine("java")
+            want = judge.process_wire([m.copy() for m in msgs[:prefix]])
+        else:
+            from kme_tpu.oracle import OracleEngine
+
+            print("bench: native judge unavailable; using the Python "
+                  "oracle", file=sys.stderr)
+            ora = OracleEngine("java")
+            want = [[r.wire() for r in ora.process(msgs[i].copy())]
+                    for i in range(prefix)]
+    else:
+        want = _judge_wire(msgs, prefix,
+                           dict(book_slots=cfg.slots,
+                                max_fills=cfg.max_fills))
     got = ses.process_wire(msgs[:prefix])
     for i in range(prefix):
         assert got[i] == want[i], \
@@ -532,7 +572,10 @@ def main(argv=None) -> int:
                    help="micro-batch size (latency suite batches; parity "
                         "suite scan length)")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--compat", choices=("java", "fixed"), default="java")
+    # None -> per-suite default: the native/parity suites judge java
+    # (their reason to exist); the lanes/seq headline is fixed-mode
+    # unless java is explicitly requested
+    p.add_argument("--compat", choices=("java", "fixed"), default=None)
     args = p.parse_args(argv)
     if args.suite == "lanes" and args.engine == "seq":
         rec = bench_seq_engine(args.events or 100_000, args.symbols,
@@ -540,7 +583,8 @@ def main(argv=None) -> int:
                                slots=args.slots or SEQ_DEFAULT_SLOTS,
                                max_fills=args.max_fills,
                                parity_prefix=args.parity_prefix,
-                               workload=args.workload)
+                               workload=args.workload,
+                               compat=args.compat or "fixed")
     elif args.suite == "lanes":
         rec = bench_lane_engine(args.events or 100_000, args.symbols,
                                 args.accounts, args.seed, args.zipf,
@@ -552,7 +596,8 @@ def main(argv=None) -> int:
                                 profile_dir=args.profile)
     elif args.suite == "native":
         rec = bench_native_engine(args.events or 100_000, args.seed,
-                                  max(args.batch, 1), args.compat)
+                                  max(args.batch, 1),
+                                  args.compat or "java")
     elif args.suite == "latency":
         rec = bench_latency(args.events or 20_000, args.symbols,
                             args.accounts, args.seed, args.zipf,
@@ -561,8 +606,8 @@ def main(argv=None) -> int:
                             width=args.width, shards=args.shards,
                             batch=args.batch, engine=args.engine)
     else:
-        rec = bench_parity_engine(args.events or 4096, args.seed, args.batch,
-                                  args.compat)
+        rec = bench_parity_engine(args.events or 4096, args.seed,
+                                  args.batch, args.compat or "java")
     out = {k: rec[k] for k in ("metric", "value", "unit", "vs_baseline")}
     print(json.dumps(out))
     print(json.dumps(rec["detail"]), file=sys.stderr)

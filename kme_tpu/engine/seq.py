@@ -80,6 +80,10 @@ L_PAYOUT_NO = 8
 L_REMOVE_SYMBOL = 9
 
 LERR_HASH_FULL = 4   # position hash exhausted (pos_cap knob)
+LERR_JAVA_DOMAIN = 5   # java mode: price/size outside the device domain
+LERR_JAVA_CAP = 6      # java mode: slots/max_fills device bound exceeded
+                       # (the reference's stores are unbounded; hitting
+                       # a static capacity is fatal, never a REJECT)
 
 I32 = jnp.int32
 _i = np.int32
@@ -90,6 +94,25 @@ LN = 128
 _STATE_KEYS = ("bo_lo", "bo_hi", "ba", "bp", "bs", "bq",
                "seqc", "bex", "bal_lo", "bal_hi", "bal_u",
                "hk", "ha_lo", "ha_hi", "hv_lo", "hv_hi", "err")
+
+# java mode: Q11 positions are keyed by 128-bit pairs — real keys
+# (aid, sid), garbage keys (amount, available) — with true deletion
+# (delete-at-zero pops arbitrary keys), so the hash carries four key
+# planes + an explicit state plane (0 empty / 1 live / 2 tombstone),
+# plus raw-id lookup tables (dense idx -> Java-long aid, lane -> sid)
+# the maker-fill path needs to BUILD keys from device-resident ids.
+_STATE_KEYS_JAVA = (
+    "bo_lo", "bo_hi", "ba", "bp", "bs", "bq",
+    "seqc", "bex", "bal_lo", "bal_hi", "bal_u",
+    "hka_lo", "hka_hi", "hkb_lo", "hkb_hi", "hstate",
+    "ha_lo", "ha_hi", "hv_lo", "hv_hi",
+    "araw_lo", "araw_hi", "sraw_lo", "sraw_hi", "err")
+
+
+def state_keys(cfg: SeqConfig):
+    return _STATE_KEYS_JAVA if cfg.compat == "java" else _STATE_KEYS
+
+AMASK = _i((1 << 30) - 1)   # java: ba plane packs aidx | is_buy << 30
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,6 +127,16 @@ class SeqConfig:
     pos_cap: int = 1 << 17     # position hash capacity (pow2 mult of 128)
     fill_cap: int = 1 << 15    # fill entries per call (mult of 128)
     probe_max: int = 64        # max hash tiles probed before HASH_FULL
+    # compat='java' replicates the reference quirk-for-quirk ON DEVICE
+    # (Q1 merged sid-0 book, Q2 ghost trades, Q9, Q11 value-as-key
+    # positions with a 128-bit-key tombstoned hash) for the stock wire
+    # surface: CREATE/TRANSFER/ADD_SYMBOL(sid>=0)/BUY/SELL/CANCEL with
+    # in-domain prices/sizes. Barriers and negative-sid symbols (dead or
+    # broken paths in the reference -- Q3-Q6) are routed to the native
+    # engine instead; out-of-domain fields trip a sticky error. fixed
+    # mode is the performance/envelope path; java mode is the
+    # quirk-exact-parity-on-TPU path (COMPAT.md).
+    compat: str = "fixed"
     # hbm_books: book planes live in HBM (pl.ANY) and the kernel keeps
     # ONE lane's rows in a VMEM scratch cache, flushed/loaded on lane
     # switch. VMEM cannot hold deep books (slots=8192 at S=1024 is
@@ -115,6 +148,7 @@ class SeqConfig:
     hbm_books: bool = False
 
     def __post_init__(self):
+        assert self.compat in ("fixed", "java")
         assert self.slots % LN == 0 and self.slots >= LN
         assert self.accounts % LN == 0
         assert self.batch % LN == 0
@@ -145,15 +179,30 @@ class SeqConfig:
 def make_seq_state(cfg: SeqConfig):
     S, NR = cfg.lanes, cfg.nr
     z = lambda r: jnp.zeros((r, LN), I32)
-    return {
+    common = {
         "bo_lo": z(2 * S * NR), "bo_hi": z(2 * S * NR), "ba": z(2 * S * NR),
         "bp": z(2 * S * NR), "bs": z(2 * S * NR), "bq": z(2 * S * NR),
         "seqc": z(cfg.srows), "bex": z(cfg.srows),
         "bal_lo": z(cfg.arows), "bal_hi": z(cfg.arows), "bal_u": z(cfg.arows),
-        "hk": z(cfg.caprows), "ha_lo": z(cfg.caprows), "ha_hi": z(cfg.caprows),
-        "hv_lo": z(cfg.caprows), "hv_hi": z(cfg.caprows),
         "err": z(1),
     }
+    if cfg.compat == "java":
+        common.update({
+            "hka_lo": z(cfg.caprows), "hka_hi": z(cfg.caprows),
+            "hkb_lo": z(cfg.caprows), "hkb_hi": z(cfg.caprows),
+            "hstate": z(cfg.caprows),
+            "ha_lo": z(cfg.caprows), "ha_hi": z(cfg.caprows),
+            "hv_lo": z(cfg.caprows), "hv_hi": z(cfg.caprows),
+            "araw_lo": z(cfg.arows), "araw_hi": z(cfg.arows),
+            "sraw_lo": z(cfg.srows), "sraw_hi": z(cfg.srows),
+        })
+    else:
+        common.update({
+            "hk": z(cfg.caprows),
+            "ha_lo": z(cfg.caprows), "ha_hi": z(cfg.caprows),
+            "hv_lo": z(cfg.caprows), "hv_hi": z(cfg.caprows),
+        })
+    return common
 
 
 # ---------------------------------------------------------------------------
@@ -274,15 +323,23 @@ def build_seq_step(cfg: SeqConfig):
     CAPMASK = _i(cfg.pos_cap - 1)
 
     HBM = cfg.hbm_books
+    JAVA = cfg.compat == "java"
+    KEYS = state_keys(cfg)
+    NSMEM = 12 if JAVA else 7
     BOOK_KEYS = ("bo_lo", "bo_hi", "ba", "bp", "bs", "bq")
 
-    def kernel(act_s, oidlo_s, oidhi_s, aid_s, price_s, size_s, lane_s,
-               *refs):
-        # refs: 17 aliased state ins, 17 state outs + out plane, then
-        # (hbm_books) 6 VMEM scratch planes + a DMA semaphore array.
-        nst = len(_STATE_KEYS)
+    def kernel(*args):
+        # args: NSMEM message arrays, then aliased state ins, state outs
+        # + out plane, then (hbm_books) 6 VMEM scratch planes + a DMA
+        # semaphore array.
+        (act_s, oidlo_s, oidhi_s, aid_s, price_s, size_s,
+         lane_s) = args[:7]
+        if JAVA:
+            aidrlo_s, aidrhi_s, sidrlo_s, sidrhi_s, flags_s = args[7:12]
+        refs = args[NSMEM:]
+        nst = len(KEYS)
         outs = refs[nst:]
-        st = dict(zip(_STATE_KEYS, outs[:nst]))
+        st = dict(zip(KEYS, outs[:nst]))
         out = outs[nst]
         if HBM:
             scr = dict(zip(BOOK_KEYS, refs[nst + nst + 1:nst + nst + 7]))
@@ -422,6 +479,160 @@ def build_seq_step(cfg: SeqConfig):
                            jnp.where(dead, z, nvlo),
                            jnp.where(dead, z, nvhi))
 
+        # -------- java (Q11) position hash: 128-bit keys, tombstones --
+        if JAVA:
+            def jhome(kal, kah, kbl, kbh):
+                h = (kal * _i(-1640531527) ^ kah * _i(-2048144789)
+                     ^ kbl * _i(-1028477387) ^ kbh * _i(69069))
+                return (h >> _i(7)) & (CAPMASK >> _i(7))
+
+            def jfind(kal, kah, kbl, kbh):
+                """-> (flat entry or -1, err). Tombstones are passed
+                over; an EMPTY slot ends the probe."""
+                def body(c):
+                    t, probes, res, done = c
+                    srow = st["hstate"][pl.ds(t, 1), :]
+                    live = srow == _i(1)
+                    eq = (live
+                          & (st["hka_lo"][pl.ds(t, 1), :] == kal)
+                          & (st["hka_hi"][pl.ds(t, 1), :] == kah)
+                          & (st["hkb_lo"][pl.ds(t, 1), :] == kbl)
+                          & (st["hkb_hi"][pl.ds(t, 1), :] == kbh))
+                    hidx = jnp.min(jnp.where(eq, ci, BIG))
+                    empty = jnp.min(jnp.where(srow == _i(0), ci, BIG))
+                    found = hidx < BIG
+                    stop = (found | (empty < BIG)
+                            | (probes + _i(1) >= _i(PROBE)))
+                    res = jnp.where(found, t * _i(LN) + hidx, res)
+                    return ((t + _i(1)) & (CAPMASK >> _i(7)),
+                            probes + _i(1), res, stop)
+
+                t0 = jhome(kal, kah, kbl, kbh)
+                _, probes, res, _ = jax.lax.while_loop(
+                    lambda c: ~c[3], body, (t0, _i(0), _i(-1), False))
+                return res, (res < _i(0)) & (probes >= _i(PROBE))
+
+            def jslot_for_insert(kal, kah, kbl, kbh):
+                """-> (flat slot, found_live, err): the live match if it
+                exists, else the first reusable (tombstone/empty) slot
+                seen on the probe path."""
+                def body(c):
+                    t, probes, res, reuse, done = c
+                    srow = st["hstate"][pl.ds(t, 1), :]
+                    live = srow == _i(1)
+                    eq = (live
+                          & (st["hka_lo"][pl.ds(t, 1), :] == kal)
+                          & (st["hka_hi"][pl.ds(t, 1), :] == kah)
+                          & (st["hkb_lo"][pl.ds(t, 1), :] == kbl)
+                          & (st["hkb_hi"][pl.ds(t, 1), :] == kbh))
+                    hidx = jnp.min(jnp.where(eq, ci, BIG))
+                    free = jnp.min(jnp.where(srow != _i(1), ci, BIG))
+                    empty = jnp.min(jnp.where(srow == _i(0), ci, BIG))
+                    found = hidx < BIG
+                    reuse = jnp.where((reuse < _i(0)) & (free < BIG),
+                                      t * _i(LN) + free, reuse)
+                    res = jnp.where(found, t * _i(LN) + hidx, res)
+                    stop = (found | (empty < BIG)
+                            | (probes + _i(1) >= _i(PROBE)))
+                    return ((t + _i(1)) & (CAPMASK >> _i(7)),
+                            probes + _i(1), res, reuse, stop)
+
+                t0 = jhome(kal, kah, kbl, kbh)
+                _, probes, res, reuse, _ = jax.lax.while_loop(
+                    lambda c: ~c[4], body,
+                    (t0, _i(0), _i(-1), _i(-1), False))
+                found = res >= _i(0)
+                slot = jnp.where(found, res, reuse)
+                return slot, found, slot < _i(0)
+
+            def jvals(e):
+                r, l = e >> _i(7), e & _i(127)
+                rr = jnp.where(e >= _i(0), r, _i(0))
+                there = e >= _i(0)
+                z = _i(0)
+                return (jnp.where(there, rget(st["ha_lo"], rr, l), z),
+                        jnp.where(there, rget(st["ha_hi"], rr, l), z),
+                        jnp.where(there, rget(st["hv_lo"], rr, l), z),
+                        jnp.where(there, rget(st["hv_hi"], rr, l), z))
+
+            def jwrite(e, kal, kah, kbl, kbh, alo, ahi, vlo, vhi):
+                r, l = e >> _i(7), e & _i(127)
+
+                @pl.when(e >= _i(0))
+                def _():
+                    put(st["hstate"], r, l, _i(1))
+                    put(st["hka_lo"], r, l, kal)
+                    put(st["hka_hi"], r, l, kah)
+                    put(st["hkb_lo"], r, l, kbl)
+                    put(st["hkb_hi"], r, l, kbh)
+                    put(st["ha_lo"], r, l, alo)
+                    put(st["ha_hi"], r, l, ahi)
+                    put(st["hv_lo"], r, l, vlo)
+                    put(st["hv_hi"], r, l, vhi)
+
+            def jdelete(e):
+                r, l = e >> _i(7), e & _i(127)
+
+                @pl.when(e >= _i(0))
+                def _():
+                    put(st["hstate"], r, l, _i(2))   # tombstone
+
+            def jfill_one(alo, ahi, slo, shi, sgn_fill):
+                """fillOrder java (Q11, KProcessor.java:276-287): first
+                fill creates the real (aid, sid) entry; later fills
+                read the real entry but write/delete the VALUE-as-key
+                (amount, available) target. -> err flag."""
+                e, err0 = jfind(alo, ahi, slo, shi)
+                amt_lo, amt_hi, av_lo, av_hi = jvals(e)
+                absent = e < _i(0)
+                nalo, nahi = _add64(amt_lo, amt_hi, *_sx(sgn_fill))
+                nvlo, nvhi = _add64(av_lo, av_hi, *_sx(sgn_fill))
+                err = err0
+
+                @pl.when(absent & ~err0)
+                def _():
+                    s2, _f, e2 = jslot_for_insert(alo, ahi, slo, shi)
+                    jwrite(s2, alo, ahi, slo, shi,
+                           sgn_fill, sgn_fill >> _i(31),
+                           sgn_fill, sgn_fill >> _i(31))
+
+                    @pl.when(e2)
+                    def _():
+                        set_err(_i(LERR_HASH_FULL))
+
+                @pl.when(~absent)
+                def _():
+                    # target key = the OLD value (amount, available)
+                    dead = (nalo == _i(0)) & (nahi == _i(0))
+
+                    @pl.when(dead)
+                    def _():
+                        t_e, _te = jfind(amt_lo, amt_hi, av_lo, av_hi)
+                        jdelete(t_e)   # pop(target, None): no-op absent
+
+                    @pl.when(~dead)
+                    def _():
+                        s2, _f, e2 = jslot_for_insert(
+                            amt_lo, amt_hi, av_lo, av_hi)
+                        jwrite(s2, amt_lo, amt_hi, av_lo, av_hi,
+                               nalo, nahi, nvlo, nvhi)
+
+                        @pl.when(e2)
+                        def _():
+                            set_err(_i(LERR_HASH_FULL))
+
+                return err
+
+            def araw_of(acc):
+                r, l = acc >> _i(7), acc & _i(127)
+                return (rget(st["araw_lo"], r, l),
+                        rget(st["araw_hi"], r, l))
+
+            def sraw_of(lane):
+                r, l = lane >> _i(7), lane & _i(127)
+                return (rget(st["sraw_lo"], r, l),
+                        rget(st["sraw_hi"], r, l))
+
         # -------- book row access -------------------------------------
         # Under hbm_books the CURRENT lane's rows live in the VMEM
         # scratch cache (lane arg ignored; the switch logic in `one`
@@ -538,6 +749,14 @@ def build_seq_step(cfg: SeqConfig):
             opp = _i(1) - side
             # sgn: buy -> +1 (low ask first), sell -> -1 (high bid first)
             sgn = jnp.where(is_buy, _i(1), _i(-1))
+            if JAVA:
+                # Q1: sid=0's buy and sell books share one key (-0 == 0)
+                # — both directions rest into and sweep side 0
+                merged = (flags_s[m] & _i(1)) != _i(0)
+                side = jnp.where(merged, _i(0), side)
+                opp = jnp.where(merged, _i(0), opp)
+                a_rlo, a_rhi = aidrlo_s[m], aidrhi_s[m]
+                s_rlo, s_rhi = sidrlo_s[m], sidrhi_s[m]
 
             if HBM:
                 needs_books = is_trade | is_cancel | is_barrier
@@ -555,6 +774,25 @@ def build_seq_step(cfg: SeqConfig):
 
             lr, ll = lane >> _i(7), lane & _i(127)
             bex_v = rget(st["bex"], lr, ll) != _i(0)
+
+            if JAVA:
+                # raw-id tables: every actor-ful message refreshes its
+                # dense->raw binding (idempotent); ADD_SYMBOL binds the
+                # lane's sid (trades gate on book_exists, so fills only
+                # ever read bound lanes)
+                has_actor = (is_trade | is_cancel | (act == _i(L_CREATE))
+                             | (act == _i(L_TRANSFER)))
+
+                @pl.when(has_actor)
+                def _():
+                    ar, al = acc >> _i(7), acc & _i(127)
+                    put(st["araw_lo"], ar, al, a_rlo)
+                    put(st["araw_hi"], ar, al, a_rhi)
+
+                @pl.when(act == _i(L_ADD_SYMBOL))
+                def _():
+                    put(st["sraw_lo"], lr, ll, s_rlo)
+                    put(st["sraw_hi"], lr, ll, s_rhi)
 
             blo, bhi = bal_get(acc)
             bal_ok = rget(st["bal_u"], acc >> _i(7), acc & _i(127)) != _i(0)
@@ -581,7 +819,17 @@ def build_seq_step(cfg: SeqConfig):
             # ---------------- TRADE: margin (checkBalance) ------------
             valid = (limit >= _i(0)) & (limit < _i(126)) & (size > _i(0))
             signed = jnp.where(is_buy, size, -size)
-            palo, pahi, pvlo, pvhi = pos_get(lane, acc)
+            if JAVA:
+                # the reference runs UNVALIDATED fields (no valid gate);
+                # out-of-domain values would corrupt the dense book
+                # layout, so they are a fatal device-envelope error
+                @pl.when(is_trade & ~valid)
+                def _():
+                    set_err(_i(LERR_JAVA_DOMAIN))
+                e_actor, aerr = jfind(a_rlo, a_rhi, s_rlo, s_rhi)
+                palo, pahi, pvlo, pvhi = jvals(e_actor)
+            else:
+                palo, pahi, pvlo, pvhi = pos_get(lane, acc)
             z64 = (_i(0), _i(0))
             nsg = _neg64(*_sx(signed))
             adjlo, adjhi = _sel64(
@@ -590,7 +838,8 @@ def build_seq_step(cfg: SeqConfig):
                 _min64(_max64((pvlo, pvhi), z64), nsg))
             unit = jnp.where(is_buy, limit, limit - _i(100))
             risk_lo, risk_hi = _muls64(signed + adjlo, unit)
-            trade_ok = (is_trade & valid & bex_v & bal_ok
+            gates = bex_v & bal_ok if JAVA else (valid & bex_v & bal_ok)
+            trade_ok = (is_trade & gates
                         & ~_lt64(blo, bhi, risk_lo, risk_hi))
 
             # ---------------- TRADE phase 1: non-mutating sweep -------
@@ -599,7 +848,7 @@ def build_seq_step(cfg: SeqConfig):
             oq_blk = side_blk("bq", lane, opp)
 
             def sweep(c):
-                wsize, fslot, ffill, remaining, e, ovf, done = c
+                wsize, fslot, ffill, remaining, e, ovf, emptied, done = c
                 cross = (wsize > _i(0)) & (
                     (op_blk - limit) * sgn <= _i(0))
                 pstar = jnp.min(jnp.where(cross, op_blk * sgn, BIG))
@@ -618,26 +867,74 @@ def build_seq_step(cfg: SeqConfig):
                 remaining = remaining - jnp.where(take, fill, _i(0))
                 e = e + jnp.where(take, _i(1), _i(0))
                 ovf = ovf | exceed
+                # did the LAST executed trade exhaust its maker exactly?
+                # (the Q2 ghost-trade precondition: the reference loop
+                # re-evaluates its guard only after a maker empties)
+                emptied = jnp.where(take, have - fill == _i(0), emptied)
                 done = (~anyc) | exceed | (remaining == _i(0))
-                return wsize, fslot, ffill, remaining, e, ovf, done
+                return wsize, fslot, ffill, remaining, e, ovf, emptied, done
 
             want = jnp.where(trade_ok, size, _i(0))
             init = (os_blk, jnp.zeros((1, LN), I32), jnp.zeros((1, LN), I32),
-                    want, _i(0), False, want == _i(0))
-            wsize, fslot, ffill, residual_t, nfill, ovf_fills, _d = \
-                jax.lax.while_loop(lambda c: ~c[6], sweep, init)
+                    want, _i(0), False, False, want == _i(0))
+            (wsize, fslot, ffill, residual_t, nfill, ovf_fills,
+             last_emptied, _d) = \
+                jax.lax.while_loop(lambda c: ~c[7], sweep, init)
+            if JAVA:
+                # Q2 (KProcessor.java:237 precedence): with the taker
+                # exhausted, the guard parses to `maker.price >= limit`
+                # regardless of direction — when the last fill emptied
+                # its maker and the NEXT best maker satisfies it, ONE
+                # zero-size trade emits before `maker.size != 0` breaks
+                live_g = wsize > _i(0)
+                gbest = jnp.min(jnp.where(live_g, op_blk * sgn, BIG))
+                g_at = live_g & (op_blk * sgn == gbest)
+                g_ss = jnp.min(jnp.where(g_at, oq_blk, BIG))
+                g_at2 = g_at & (oq_blk == g_ss)
+                gflat = jnp.min(jnp.where(g_at2, fi, BIG))
+                gfc = jnp.where(gbest < BIG, gflat, _i(0))
+                g_price = pick2(op_blk, gfc)
+                ghost = (trade_ok & (residual_t == _i(0)) & last_emptied
+                         & (gbest < BIG) & (g_price >= limit))
+                ghost_ok = ghost & (nfill < _i(E))
+
+                @pl.when(ghost & (nfill >= _i(E)))
+                def _():
+                    set_err(_i(LERR_JAVA_CAP))
+
+                fslot = jnp.where(ghost_ok & (ci == nfill), gfc, fslot)
+                ffill = jnp.where(ghost_ok & (ci == nfill), _i(0), ffill)
+                nfill = nfill + ghost_ok.astype(I32)
 
             # ---------------- capacity envelope + Q9 ------------------
             w_blk = side_blk("bs", lane, side)      # own side sizes
+            if JAVA:
+                # merged (Q1) books: the sweep just consumed from the
+                # SAME side the residual rests on — the free-slot
+                # search and the Q9 bucket tail must see POST-sweep
+                # sizes (the reference's bitmap bit is unset when the
+                # bucket empties mid-sweep, so the rest creates a NEW
+                # bucket with prev = null)
+                w_blk = jnp.where(is_trade & merged, wsize, w_blk)
             wp_blk = side_blk("bp", lane, side)
             wq_blk = side_blk("bq", lane, side)
             free_flat = jnp.min(jnp.where(w_blk == _i(0), fi, BIG))
             have_free = free_flat < BIG
             rest_want = trade_ok & (residual_t > _i(0))
             ovf_book = rest_want & ~have_free
-            cap_reject = trade_ok & (ovf_fills | ovf_book)
-            trade_acc = trade_ok & ~cap_reject
-            do_rest = rest_want & trade_acc
+            if JAVA:
+                # unbounded reference stores: hitting a device capacity
+                # is FATAL (sticky error), never a per-message REJECT
+                @pl.when(trade_ok & (ovf_fills | ovf_book))
+                def _():
+                    set_err(_i(LERR_JAVA_CAP))
+
+                cap_reject = is_trade & False
+                trade_acc = trade_ok
+            else:
+                cap_reject = trade_ok & (ovf_fills | ovf_book)
+                trade_acc = trade_ok & ~cap_reject
+            do_rest = rest_want & trade_acc & have_free
 
             same_level = (w_blk > _i(0)) & (wp_blk == limit)
             bucket_nonempty = jnp.max(
@@ -663,11 +960,18 @@ def build_seq_step(cfg: SeqConfig):
                 @pl.when(adj_nz)
                 def _():
                     nvlo, nvhi = _add64(pvlo, pvhi, *_neg64(adjlo, adjhi))
-                    e = pos_set(lane, acc, palo, pahi, nvlo, nvhi)
+                    if JAVA:
+                        # 3-arg setPosition: the REAL key keeps its
+                        # amount, only `available` moves
+                        # (KProcessor.java:179, exempt from Q11)
+                        jwrite(e_actor, a_rlo, a_rhi, s_rlo, s_rhi,
+                               palo, pahi, nvlo, nvhi)
+                    else:
+                        e = pos_set(lane, acc, palo, pahi, nvlo, nvhi)
 
-                    @pl.when(e)
-                    def _():
-                        set_err(_i(LERR_HASH_FULL))
+                        @pl.when(e)
+                        def _():
+                            set_err(_i(LERR_HASH_FULL))
 
                 # maker size writeback (size==0 deletes the slot)
                 side_put("bs", lane, opp, wsize)
@@ -679,7 +983,9 @@ def build_seq_step(cfg: SeqConfig):
                 def apply_fill(e2, _c):
                     flat = pick(fslot, e2)
                     fill = pick(ffill, e2)
-                    maid = pick2(oa_blk, flat)
+                    maid_raw_plane = pick2(oa_blk, flat)
+                    maid = (maid_raw_plane & AMASK) if JAVA \
+                        else maid_raw_plane
                     mprice = pick2(op_blk, flat)
                     p = fill_total + e2
                     pc = jnp.minimum(p, _i(FB - 1))
@@ -693,11 +999,19 @@ def build_seq_step(cfg: SeqConfig):
                         fill_put(4, pc, fill)
 
                     # maker fill then taker fill (executeTrade order)
-                    me = fill_one(lane, maid, jnp.where(is_buy, -fill, fill))
-                    te = fill_one(lane, acc, jnp.where(is_buy, fill, -fill))
+                    msz = jnp.where(is_buy, -fill, fill)
+                    tsz = jnp.where(is_buy, fill, -fill)
+                    if JAVA:
+                        mr, ml = maid >> _i(7), maid & _i(127)
+                        m_rlo = rget(st["araw_lo"], mr, ml)
+                        m_rhi = rget(st["araw_hi"], mr, ml)
+                        me = jfill_one(m_rlo, m_rhi, s_rlo, s_rhi, msz)
+                        te = jfill_one(a_rlo, a_rhi, s_rlo, s_rhi, tsz)
+                    else:
+                        me = fill_one(lane, maid, msz)
+                        te = fill_one(lane, acc, tsz)
                     # taker credit: int*int wraps at i32 before the
                     # long add (KProcessor.java:286); maker credit is 0
-                    tsz = jnp.where(is_buy, fill, -fill)
                     bal_add(acc, *_sx(tsz * (limit - mprice)))
 
                     @pl.when(me | te)
@@ -721,7 +1035,9 @@ def build_seq_step(cfg: SeqConfig):
                     seqv = rget(st["seqc"], lr, ll)
                     slot_write("bo_lo", lane, side, free_flat, t_oidlo)
                     slot_write("bo_hi", lane, side, free_flat, t_oidhi)
-                    slot_write("ba", lane, side, free_flat, acc)
+                    ba_val = (acc | (is_buy.astype(I32) << _i(30))) \
+                        if JAVA else acc
+                    slot_write("ba", lane, side, free_flat, ba_val)
                     slot_write("bp", lane, side, free_flat, limit)
                     slot_write("bs", lane, side, free_flat, residual_t)
                     slot_write("bq", lane, side, free_flat, seqv)
@@ -743,7 +1059,12 @@ def build_seq_step(cfg: SeqConfig):
             c_flat = jnp.where(f0 < BIG, f0, f1)
             hit_any = is_cancel & (c_flat < BIG)
             cfc = jnp.where(hit_any, c_flat, _i(0))
-            c_aid = pick2(side_blk("ba", lane, c_side), cfc)
+            c_ba = pick2(side_blk("ba", lane, c_side), cfc)
+            c_aid = (c_ba & AMASK) if JAVA else c_ba
+            # merged (Q1) books hold both directions in side 0, so java
+            # reads the order's direction from the ba tag bit
+            c_isbuy = ((c_ba >> _i(30)) & _i(1)) == _i(1) if JAVA \
+                else c_side == _i(0)
             c_price = pick2(side_blk("bp", lane, c_side), cfc)
             c_size = pick2(side_blk("bs", lane, c_side), cfc)
             cancel_ok = hit_any & (c_aid == acc)
@@ -751,15 +1072,50 @@ def build_seq_step(cfg: SeqConfig):
             @pl.when(cancel_ok)
             def _():
                 slot_write("bs", lane, c_side, c_flat, _i(0))
-                rlo, rhi = release_margin(lane, acc, c_side == _i(0),
-                                          c_price, c_size)
+                if JAVA:
+                    # postRemoveAdjustments is Q11-CORRUPTED too
+                    # (KProcessor.java:332, 2-arg setPosition): the
+                    # adj-write lands on the VALUE-as-key target, the
+                    # real (aid, sid) entry stays untouched
+                    e_c, _ce = jfind(a_rlo, a_rhi, s_rlo, s_rhi)
+                    calo, cahi, cvlo, cvhi = jvals(e_c)
+                    cblo, cbhi = _add64(calo, cahi, *_neg64(cvlo, cvhi))
+                    csigned = jnp.where(c_isbuy, c_size, -c_size)
+                    cz = (_i(0), _i(0))
+                    cns = _neg64(*_sx(csigned))
+                    cjlo, cjhi = _sel64(
+                        c_isbuy,
+                        _max64(_min64((cblo, cbhi), cz), cns),
+                        _min64(_max64((cblo, cbhi), cz), cns))
+                    cunit = jnp.where(c_isbuy, c_price,
+                                      c_price - _i(100))
+                    rlo, rhi = _muls64(csigned + cjlo, cunit)
+                    c_nz = (cjlo != _i(0)) | (cjhi != _i(0))
+
+                    @pl.when(c_nz)
+                    def _():
+                        nvlo, nvhi = _add64(cvlo, cvhi, cjlo, cjhi)
+                        s2, _f2, ce2 = jslot_for_insert(
+                            calo, cahi, cvlo, cvhi)
+                        jwrite(s2, calo, cahi, cvlo, cvhi,
+                               calo, cahi, nvlo, nvhi)
+
+                        @pl.when(ce2)
+                        def _():
+                            set_err(_i(LERR_HASH_FULL))
+                else:
+                    rlo, rhi = release_margin(lane, acc, c_isbuy,
+                                              c_price, c_size)
                 bal_add(acc, rlo, rhi)
 
             # ---------------- BARRIERS (payout / remove) --------------
-            barrier_do = is_barrier & bex_v
+            barrier_do = is_barrier & bex_v if not JAVA \
+                else is_barrier & False
 
             @pl.when(barrier_do)
             def _():
+                if JAVA:
+                    return  # the java router never routes barriers
                 # wipe both sides with margin release, buy side first,
                 # (price, seq) order within a side (_wipe_book_fixed)
                 def wipe_side(wside):
@@ -921,7 +1277,10 @@ def build_seq_step(cfg: SeqConfig):
             scal = jnp.where(ci == _i(2 + k), met[k], scal)
         out[0:1, :] = scal
 
-    nstate = len(_STATE_KEYS)
+    nstate = len(KEYS)
+    MSG_FIELDS = ("act", "oid_lo", "oid_hi", "aid", "price", "size",
+                  "lane") + (("aidr_lo", "aidr_hi", "sidr_lo",
+                              "sidr_hi", "flags") if JAVA else ())
 
     def _spec(key):
         if cfg.hbm_books and key in BOOK_KEYS:
@@ -937,19 +1296,18 @@ def build_seq_step(cfg: SeqConfig):
             kernel,
             out_shape=tuple(
                 [jax.ShapeDtypeStruct(state[k].shape, I32)
-                 for k in _STATE_KEYS]
+                 for k in KEYS]
                 + [jax.ShapeDtypeStruct((NROWS, LN), I32)]),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 7
-            + [_spec(k) for k in _STATE_KEYS],
-            out_specs=tuple([_spec(k) for k in _STATE_KEYS]
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * NSMEM
+            + [_spec(k) for k in KEYS],
+            out_specs=tuple([_spec(k) for k in KEYS]
                             + [pl.BlockSpec(memory_space=pltpu.VMEM)]),
-            input_output_aliases={7 + k: k for k in range(nstate)},
+            input_output_aliases={NSMEM + k: k for k in range(nstate)},
             scratch_shapes=scratches,
             interpret=jax.default_backend() != "tpu",
-        )(msgs["act"], msgs["oid_lo"], msgs["oid_hi"], msgs["aid"],
-          msgs["price"], msgs["size"], msgs["lane"],
-          *[state[k] for k in _STATE_KEYS])
-        new_state = dict(zip(_STATE_KEYS, outs[:nstate]))
+        )(*[msgs[f] for f in MSG_FIELDS],
+          *[state[k] for k in KEYS])
+        new_state = dict(zip(KEYS, outs[:nstate]))
         return new_state, outs[nstate]
 
     # NOTE: jit-level donation composes badly with the pallas-level
@@ -986,15 +1344,26 @@ def pack_msgs(cfg: SeqConfig, cols: dict, n: int) -> dict:
     """Columnar router output (numpy, length n <= batch) -> padded
     (B,) i32 input dict. Padding entries are NOPs."""
     B = cfg.batch
+
+    def split64(name, src64):
+        v = np.zeros(B, np.int64)
+        v[:n] = src64[:n]
+        return {f"{name}_lo": (v & 0xFFFFFFFF).astype(np.uint32)
+                .astype(np.int32),
+                f"{name}_hi": (v >> 32).astype(np.int32)}
+
     out = {}
     for k in ("act", "aid", "price", "size", "lane"):
         a = np.zeros(B, np.int32)
         a[:n] = cols[k][:n]
         out[k] = a
-    oid = np.zeros(B, np.int64)
-    oid[:n] = cols["oid"][:n]
-    out["oid_lo"] = (oid & 0xFFFFFFFF).astype(np.uint32).astype(np.int32)
-    out["oid_hi"] = (oid >> 32).astype(np.int32)
+    out.update(split64("oid", cols["oid"]))
+    if cfg.compat == "java":
+        out.update(split64("aidr", cols["aid_raw"]))
+        out.update(split64("sidr", cols["sid_raw"]))
+        fl = np.zeros(B, np.int32)
+        fl[:n] = cols["flags"][:n]
+        out["flags"] = fl
     return out
 
 
@@ -1047,10 +1416,57 @@ def unpack_out(cfg: SeqConfig, plane: np.ndarray, n: int) -> dict:
     return res
 
 
+def export_java(cfg: SeqConfig, state) -> dict:
+    """Host view of a JAVA-mode state: positions keyed by the 128-bit
+    (ka, kb) pairs exactly as the java oracle's dict (real keys
+    (aid, sid) AND Q11 garbage keys (amount, available)); orders carry
+    the direction tag; seq/book planes as in fixed mode."""
+    assert cfg.compat == "java"
+    S, N, NR = cfg.lanes, cfg.slots, cfg.nr
+    h = {k: np.asarray(state[k]) for k in state_keys(cfg)}
+
+    def planes2slot(lo, hi=None):
+        v = lo.reshape(S, 2, NR * LN)[:, :, :N]
+        if hi is None:
+            return v
+        return ((v.astype(np.int64) & 0xFFFFFFFF)
+                | (hi.reshape(S, 2, NR * LN)[:, :, :N].astype(np.int64)
+                   << 32))
+
+    def j64(lo, hi):
+        return ((lo.astype(np.int64) & 0xFFFFFFFF)
+                | (hi.astype(np.int64) << 32))
+
+    live = h["hstate"].reshape(-1) == 1
+    ka = j64(h["hka_lo"].reshape(-1), h["hka_hi"].reshape(-1))[live]
+    kb = j64(h["hkb_lo"].reshape(-1), h["hkb_hi"].reshape(-1))[live]
+    amt = j64(h["ha_lo"].reshape(-1), h["ha_hi"].reshape(-1))[live]
+    av = j64(h["hv_lo"].reshape(-1), h["hv_hi"].reshape(-1))[live]
+    positions = {(int(a), int(b)): (int(x), int(y))
+                 for a, b, x, y in zip(ka, kb, amt, av)}
+    A = cfg.accounts
+    bal = j64(h["bal_lo"].reshape(-1)[:A], h["bal_hi"].reshape(-1)[:A])
+    return {
+        "positions": positions,
+        "bal": bal,
+        "bal_used": h["bal_u"].reshape(-1)[:A] != 0,
+        "slot_oid": planes2slot(h["bo_lo"], h["bo_hi"]),
+        "slot_ba": planes2slot(h["ba"]).astype(np.int64),
+        "slot_price": planes2slot(h["bp"]).astype(np.int32),
+        "slot_size": planes2slot(h["bs"]).astype(np.int32),
+        "book_exists": h["bex"].reshape(-1)[:S] != 0,
+        "err": np.int32(h["err"].reshape(-1)[0]),
+    }
+
+
 # ---------------------------------------------------------------------------
 # canonical (lanes-style) state import/export for checkpoint parity
 
 def export_canonical(cfg: SeqConfig, state) -> dict:
+    if cfg.compat == "java":
+        raise NotImplementedError(
+            "java-mode seq state has no canonical snapshot yet — use "
+            "the native engine for durable java serving (COMPAT.md)")
     """Device planes -> the canonical snapshot layout the lanes engine
     checkpoints use (slot_* (S,2,N) i64/i32/bool, flat positions s64,
     bal s64) so snapshots restore across engines."""

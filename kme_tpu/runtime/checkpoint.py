@@ -270,6 +270,10 @@ def save_seq_session(ckpt_dir: str, session, offset: int) -> str:
     shard/width topologies."""
     from kme_tpu.engine import seq as SQ
 
+    if session.cfg.compat == "java":
+        raise NotImplementedError(
+            "java-mode seq sessions have no canonical snapshot yet — "
+            "use the native engine for durable java serving")
     os.makedirs(ckpt_dir, exist_ok=True)
     canon = SQ.export_canonical(session.cfg, session.state)
     r = session.router

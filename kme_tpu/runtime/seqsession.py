@@ -34,17 +34,35 @@ from kme_tpu.wire import OrderMsg, OutRecord, order_json
 # it (the code space is shared with the lanes engine's LERR_*)
 _session._LERR_NAMES[SQ.LERR_HASH_FULL] = \
     "position hash exhausted (pos_cap knob)"
+_session._LERR_NAMES[SQ.LERR_JAVA_DOMAIN] = \
+    "java mode: price/size outside the device domain (the reference " \
+    "runs unvalidated fields; this stream needs the native engine)"
+_session._LERR_NAMES[SQ.LERR_JAVA_CAP] = \
+    "java mode: device capacity exceeded (reference stores are " \
+    "unbounded -- raise slots/max_fills or use the native engine)"
 
 _TRADE_ACTS = {op.BUY: SQ.L_BUY, op.SELL: SQ.L_SELL}
 
 
+class UnsupportedJavaOp(RuntimeError):
+    """The java-compat DEVICE surface excludes barriers and negative-sid
+    symbols (dead or broken reference paths — Q3-Q6 and the ±sid book
+    cross-coupling); streams containing them belong on the native/oracle
+    engines (COMPAT.md)."""
+
+
 class SeqRouter:
     """Arrival-order ID routing (no conflict analysis). Mirrors the
-    sequencer's id spaces and host-reject edge semantics."""
+    sequencer's id spaces and host-reject edge semantics. compat='java'
+    additionally emits the raw Java-long aid/sid columns and the Q1
+    merged-book flag the kernel needs, and REFUSES the opcodes outside
+    the java device surface."""
 
-    def __init__(self, num_lanes: int, num_accounts: int) -> None:
+    def __init__(self, num_lanes: int, num_accounts: int,
+                 compat: str = "fixed") -> None:
         self.S = num_lanes
         self.A = num_accounts
+        self.compat = compat
         self.aid_idx: Dict[int, int] = {}
         self.sid_lane: Dict[int, int] = {}
         self.oid_sid: Dict[int, int] = {}
@@ -82,11 +100,13 @@ class SeqRouter:
         """-> (cols dict incl. msg_index, host_reject msg indices)."""
         from kme_tpu.oracle import javalong as jl
 
+        java = self.compat == "java"
         cols = {k: [] for k in ("msg_index", "act", "aid", "price",
-                                "size", "lane", "oid")}
+                                "size", "lane", "oid", "aid_raw",
+                                "sid_raw", "flags")}
         host_rejects = set()
 
-        def emit(i, act, aidx, lane, m, oid):
+        def emit(i, act, aidx, lane, m, oid, aid=0, sid=0):
             cols["msg_index"].append(i)
             cols["act"].append(act)
             cols["aid"].append(aidx)
@@ -94,35 +114,60 @@ class SeqRouter:
             cols["size"].append(m.size)
             cols["lane"].append(lane)
             cols["oid"].append(oid)
+            if java:
+                cols["aid_raw"].append(aid)
+                cols["sid_raw"].append(sid)
+                cols["flags"].append(1 if sid == 0 else 0)
 
+        # envelope-check the WHOLE batch up front so an EnvelopeError
+        # leaves the id maps untouched (the native router's contract;
+        # native/sched.py documents the same for the scheduler)
         for i, m in enumerate(msgs):
-            a = m.action
             if not (-2**31 <= m.price < 2**31 and -2**31 <= m.size < 2**31):
                 raise EnvelopeError(
                     f"message {i}: price/size outside int32 "
                     f"(price={m.price}, size={m.size})")
+        for i, m in enumerate(msgs):
+            a = m.action
             aid, sid, oid = jl.jlong(m.aid), jl.jlong(m.sid), jl.jlong(m.oid)
             if a in _TRADE_ACTS:
+                if java and sid < 0:
+                    raise UnsupportedJavaOp(
+                        f"message {i}: negative-sid trade (sid={sid}) — "
+                        f"java ±sid book coupling is outside the device "
+                        f"surface; use the native engine")
                 lane = self._lane(sid)
                 self.oid_sid[oid] = sid
-                emit(i, _TRADE_ACTS[a], self._acct(aid), lane, m, oid)
+                emit(i, _TRADE_ACTS[a], self._acct(aid), lane, m, oid,
+                     aid, sid)
             elif a == op.CANCEL:
                 rsid = self.oid_sid.get(oid)
                 if rsid is None:
                     host_rejects.add(i)
                     continue
                 emit(i, SQ.L_CANCEL, self._acct(aid), self._lane(rsid),
-                     m, oid)
+                     m, oid, aid, rsid)
             elif a == op.CREATE_BALANCE:
-                emit(i, SQ.L_CREATE, self._acct(aid), 0, m, oid)
+                emit(i, SQ.L_CREATE, self._acct(aid), 0, m, oid, aid, 0)
             elif a == op.TRANSFER:
-                emit(i, SQ.L_TRANSFER, self._acct(aid), 0, m, oid)
+                emit(i, SQ.L_TRANSFER, self._acct(aid), 0, m, oid,
+                     aid, 0)
             elif a == op.ADD_SYMBOL:
+                if java and sid < 0:
+                    raise UnsupportedJavaOp(
+                        f"message {i}: negative-sid ADD_SYMBOL "
+                        f"(sid={sid}) — outside the java device surface")
                 if sid < 0:
                     host_rejects.add(i)
                     continue
-                emit(i, SQ.L_ADD_SYMBOL, 0, self._lane(sid), m, oid)
+                emit(i, SQ.L_ADD_SYMBOL, 0, self._lane(sid), m, oid,
+                     aid, sid)
             elif a in (op.REMOVE_SYMBOL, op.PAYOUT):
+                if java:
+                    raise UnsupportedJavaOp(
+                        f"message {i}: {'REMOVE_SYMBOL' if a == 1 else 'PAYOUT'} "
+                        f"in java mode — Q3-Q6 barrier paths are outside "
+                        f"the device surface; use the native engine")
                 s = abs(sid)
                 if s not in self.sid_lane:
                     host_rejects.add(i)
@@ -147,6 +192,10 @@ class SeqRouter:
             "lane": np.array(cols["lane"], np.int32),
             "oid": np.array(cols["oid"], np.int64),
         }
+        if java:
+            out["aid_raw"] = np.array(cols["aid_raw"], np.int64)
+            out["sid_raw"] = np.array(cols["sid_raw"], np.int64)
+            out["flags"] = np.array(cols["flags"], np.int32)
         return out, host_rejects
 
 
@@ -294,10 +343,14 @@ class NativeSeqRouter:
         return cols, rejects
 
 
-def make_seq_router(num_lanes: int, num_accounts: int):
+def make_seq_router(num_lanes: int, num_accounts: int,
+                    compat: str = "fixed"):
     """The native router when the toolchain/library is available
     (KME_NATIVE=0 disables), else the Python implementation — identical
-    routing either way (tests/test_seq_engine.py)."""
+    routing either way (tests/test_seq_engine.py). java mode always
+    uses the Python router (it carries the raw-id/flag columns)."""
+    if compat == "java":
+        return SeqRouter(num_lanes, num_accounts, compat="java")
     try:
         from kme_tpu.native import load_library
 
@@ -322,7 +375,8 @@ class SeqSession:
     def __init__(self, cfg: SQ.SeqConfig) -> None:
         self.cfg = cfg
         self.state = SQ.make_seq_state(cfg)
-        self.router = make_seq_router(cfg.lanes, cfg.accounts)
+        self.router = make_seq_router(cfg.lanes, cfg.accounts,
+                                      compat=cfg.compat)
         self._metrics = np.zeros(SQ.N_METRICS, np.int64)
         self._recon = None          # native reconstructor handle
         self.phases = {}            # wall time per phase of the last run
@@ -351,17 +405,22 @@ class SeqSession:
         HR = SQ.hdr_rows(self.cfg)
         nk = max(-(-n // B), 1)
         K = pow2_bucket(nk, lo=1)
-        stacked = {f: np.zeros((K, B), np.int32)
-                   for f in ("act", "aid", "price", "size", "lane",
-                             "oid_lo", "oid_hi")}
+        pk_fields = ["act", "aid", "price", "size", "lane",
+                     "oid_lo", "oid_hi"]
+        if self.cfg.compat == "java":
+            pk_fields += ["aidr_lo", "aidr_hi", "sidr_lo", "sidr_hi",
+                          "flags"]
+        stacked = {f: np.zeros((K, B), np.int32) for f in pk_fields}
+        fields = ["act", "aid", "price", "size", "lane", "oid"]
+        if self.cfg.compat == "java":
+            fields += ["aid_raw", "sid_raw", "flags"]
         cnts = []
         for ci in range(K):
             lo = ci * B
             cnt = max(min(B, n - lo), 0)
             cnts.append(cnt)
             if cnt:
-                chunk = {f: cols[f][lo:lo + cnt] for f in
-                         ("act", "aid", "price", "size", "lane", "oid")}
+                chunk = {f: cols[f][lo:lo + cnt] for f in fields}
                 packed = SQ.pack_msgs(self.cfg, chunk, cnt)
                 for f in stacked:
                     stacked[f][ci] = packed[f]
@@ -659,6 +718,18 @@ class SeqSession:
 
     def metrics(self) -> Dict[str, int]:
         counters = dict(zip(SQ.METRIC_NAMES, self._metrics.tolist()))
+        if self.cfg.compat == "java":
+            j = SQ.export_java(self.cfg, self.state)
+            used = j["slot_size"] > 0
+            counters.update({
+                "open_orders": int(used.sum()),
+                "books": int(j["book_exists"].sum()),
+                "accounts": int(j["bal_used"].sum()),
+                "positions": len(j["positions"]),
+                "max_book_depth": int(used.sum(axis=2).max())
+                if used.size else 0,
+            })
+            return counters
         canon = SQ.export_canonical(self.cfg, self.state)
         used = canon["slot_used"]
         depth = used.sum(axis=2)
@@ -672,7 +743,9 @@ class SeqSession:
         return counters
 
     def export_state(self) -> Dict[str, dict]:
-        """Oracle-comparable host dict view (fixed mode)."""
+        """Oracle-comparable host dict view."""
+        if self.cfg.compat == "java":
+            return self._export_state_java()
         canon = SQ.export_canonical(self.cfg, self.state)
         idx_to_aid = self.router.acct_of_idx()
         lane_to_sid = self.router.sid_of_lane()
@@ -706,4 +779,36 @@ class SeqSession:
         books = {sid: True for sid, lane in self.router.sid_lane.items()
                  if canon["book_exists"][lane]}
         return {"balances": balances, "positions": positions,
+                "orders": orders, "books": books}
+
+    def _export_state_java(self) -> Dict[str, dict]:
+        """Java-mode stores, oracle-comparable: positions keyed by the
+        raw 128-bit pairs (real AND Q11 garbage keys), orders with the
+        original direction from the ba tag bit."""
+        j = SQ.export_java(self.cfg, self.state)
+        idx_to_aid = self.router.acct_of_idx()
+        lane_to_sid = self.router.sid_of_lane()
+        balances = {idx_to_aid[i]: int(j["bal"][i])
+                    for i in range(len(idx_to_aid)) if j["bal_used"][i]}
+        orders = {}
+        S, _, N = j["slot_oid"].shape
+        AM = (1 << 30) - 1
+        for lane in range(S):
+            sid = lane_to_sid.get(lane)
+            if sid is None:
+                continue
+            for side in range(2):
+                for nn in range(N):
+                    if j["slot_size"][lane, side, nn] > 0:
+                        ba = int(j["slot_ba"][lane, side, nn])
+                        orders[int(j["slot_oid"][lane, side, nn])] = {
+                            "aid": idx_to_aid[ba & AM],
+                            "sid": sid,
+                            "price": int(j["slot_price"][lane, side, nn]),
+                            "size": int(j["slot_size"][lane, side, nn]),
+                            "is_buy": (ba >> 30) & 1 == 1,
+                        }
+        books = {sid: True for sid, lane in self.router.sid_lane.items()
+                 if j["book_exists"][lane]}
+        return {"balances": balances, "positions": j["positions"],
                 "orders": orders, "books": books}
