@@ -43,12 +43,20 @@ class MatchService:
                  checkpoint_every: int = 4096) -> None:
         if engine not in ("lanes", "seq", "oracle", "native"):
             raise ValueError(f"unknown engine {engine!r}")
-        if engine in ("lanes", "seq") and compat != "fixed":
-            raise ValueError("the device engines are fixed-mode only; "
-                             "use engine='oracle'/'native' for "
-                             "compat='java'")
+        if compat not in ("java", "fixed"):
+            raise ValueError(f"unknown compat {compat!r}")
+        if engine == "lanes" and compat != "fixed":
+            raise ValueError("the lanes engine is fixed-mode only; use "
+                             "engine='seq' (stock wire surface), "
+                             "'native' or 'oracle' for compat='java'")
+        if engine == "seq" and compat == "java" \
+                and checkpoint_dir is not None:
+            raise ValueError(
+                "java-mode seq sessions have no canonical snapshot yet "
+                "— serve java durably with engine='native' (COMPAT.md)")
         self.broker = broker
         self.engine_kind = engine
+        self._compat = compat
         self.batch = batch
         self.strict = strict
         self.offset = 0
@@ -109,7 +117,8 @@ class MatchService:
         return SQ.SeqConfig(
             lanes=self._req_symbols, slots=slots,
             accounts=-(-self._req_accounts // 128) * 128,
-            max_fills=self._req_max_fills, hbm_books=slots > 512)
+            max_fills=self._req_max_fills, hbm_books=slots > 512,
+            compat=self._compat)
 
     def _try_resume(self, engine: str, compat: str, shards: int,
                     width: int) -> bool:

@@ -148,7 +148,8 @@ class SeqConfig:
     hbm_books: bool = False
 
     def __post_init__(self):
-        assert self.compat in ("fixed", "java")
+        if self.compat not in ("fixed", "java"):
+            raise ValueError(f"unknown compat {self.compat!r}")
         assert self.slots % LN == 0 and self.slots >= LN
         assert self.accounts % LN == 0
         assert self.batch % LN == 0

@@ -145,3 +145,34 @@ def test_java_unsupported_ops_raise():
         ses.process_wire([OrderMsg(action=op.PAYOUT, sid=1, size=97)])
     with pytest.raises(UnsupportedJavaOp):
         ses.process_wire([OrderMsg(action=op.ADD_SYMBOL, sid=-3)])
+
+
+def test_java_seq_service(tmp_path):
+    """kme-serve's engine='seq' + compat='java': the full service loop
+    byte-exact vs the java oracle on the stock harness shape."""
+    from kme_tpu.bridge.broker import InProcessBroker
+    from kme_tpu.bridge.consume import consume_lines
+    from kme_tpu.bridge.provision import provision
+    from kme_tpu.bridge.service import MatchService
+    from kme_tpu.wire import dumps_order
+
+    msgs = harness_stream(400, seed=5)
+    ora = OracleEngine("java")
+    want = []
+    for m in msgs:
+        for r in ora.process(m.copy()):
+            want.append(r.wire())
+    b = InProcessBroker()
+    provision(b)
+    for m in msgs:
+        b.produce("MatchIn", None, dumps_order(m))
+    svc = MatchService(b, engine="seq", compat="java", batch=64,
+                       symbols=8, accounts=128, slots=256, max_fills=64)
+    assert svc.run(max_messages=len(msgs)) == len(msgs)
+    got = list(consume_lines(b, follow=False))
+    assert got == want
+    # durable java serving stays on the native engine
+    with pytest.raises(ValueError):
+        MatchService(b, engine="seq", compat="java", symbols=8,
+                     accounts=128, slots=256, max_fills=64,
+                     checkpoint_dir=str(tmp_path))
