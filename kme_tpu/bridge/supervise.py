@@ -60,7 +60,22 @@ def supervise(serve_args, checkpoint_dir: str, stale_after: float = 10.0,
     """Run kme-serve under supervision; returns the child's final rc.
 
     serve_args: argv tail passed to `kme-serve` verbatim (the supervisor
-    adds --checkpoint-dir and --health-file itself)."""
+    adds --checkpoint-dir and --health-file itself; a user-supplied
+    occurrence of either inside serve_args would silently WIN under
+    argparse's last-occurrence rule, leaving the supervisor watching a
+    heartbeat file the child never writes — so both are rejected)."""
+    reserved = ("--checkpoint-dir", "--health-file")
+    for a in serve_args:
+        flag = a.split("=", 1)[0]
+        # argparse abbreviation: any prefix of a reserved flag resolves
+        # to it in the child (allow_abbrev default), so prefixes are
+        # rejected too
+        if (flag.startswith("--") and len(flag) > 2
+                and any(r.startswith(flag) for r in reserved)):
+            raise ValueError(
+                f"{flag} is managed by the supervisor and cannot appear "
+                f"in serve_args (the child must write the heartbeat/"
+                f"checkpoints the supervisor watches)")
     hb = os.path.join(checkpoint_dir, "serve.health")
     base = [sys.executable, "-m", "kme_tpu.cli", "serve",
             "--checkpoint-dir", checkpoint_dir,
@@ -141,10 +156,14 @@ def main(argv=None) -> int:
     if serve_args and serve_args[0] == "--":
         serve_args = serve_args[1:]
     os.makedirs(args.checkpoint_dir, exist_ok=True)
-    return supervise(serve_args, args.checkpoint_dir,
-                     stale_after=args.stale_after,
-                     max_restarts=args.max_restarts, grace=args.grace,
-                     stall_after=args.stall_after)
+    try:
+        return supervise(serve_args, args.checkpoint_dir,
+                         stale_after=args.stale_after,
+                         max_restarts=args.max_restarts, grace=args.grace,
+                         stall_after=args.stall_after)
+    except ValueError as e:
+        print(f"kme-supervise: {e}", file=sys.stderr)
+        return 2
 
 
 if __name__ == "__main__":

@@ -1578,20 +1578,22 @@ def import_canonical(cfg: SeqConfig, canon: dict):
     hvhi = np.zeros(cfg.pos_cap, np.int32)
     capr = cfg.caprows
     tilemask = capr - 1
+    # the kernel's h_find/h_claim stop after min(probe_max, capr) tiles;
+    # an entry the import places beyond that bound would be silently
+    # INVISIBLE to the device (pos_get returns zeros), so the host probe
+    # is bounded identically and overflow is a loud error
+    probe_lim = min(cfg.probe_max, capr)
     for k in live:
-        key = np.int32(k + 1)
-        t = (np.int32(np.int64(key) * -1640531527 & 0xFFFFFFFF
-                      - 0x100000000 * ((np.int64(key) * -1640531527
-                                        & 0xFFFFFFFF) >> 31)) >> 7) \
-            & tilemask
-        # match the kernel's hash exactly via int32 wrap
-        t = int((np.int32(np.int64(key) * np.int64(-1640531527)
-                          & 0xFFFFFFFF if False else
-                          np.int64(key) * np.int64(-1640531527))
-                 >> 7) & tilemask) if False else int(t)
+        key = int(k) + 1
+        # home tile = the kernel's Fibonacci hash (h_home) in int32 wrap
+        # arithmetic: ((key * -1640531527) >> 7) & tilemask
+        h = (key * -1640531527) & 0xFFFFFFFF
+        if h >= 1 << 31:
+            h -= 1 << 32
+        t = (h >> 7) & tilemask
         placed = False
-        for _p in range(capr):
-            base = (int(t) % capr) * LN
+        for p in range(probe_lim):
+            base = ((t + p) & tilemask) * LN
             row = hk[base:base + LN]
             empt = np.nonzero(row == 0)[0]
             if len(empt):
@@ -1601,16 +1603,17 @@ def import_canonical(cfg: SeqConfig, canon: dict):
                     return np.int32(lo - (1 << 32) if lo >= (1 << 31)
                                     else lo)
 
-                hk[j] = key
+                hk[j] = np.int32(key)
                 halo[j] = _lo(pos_amt[int(k)])
                 hahi[j] = np.int32(int(pos_amt[int(k)]) >> 32)
                 hvlo[j] = _lo(pos_avail[int(k)])
                 hvhi[j] = np.int32(int(pos_avail[int(k)]) >> 32)
                 placed = True
                 break
-            t = int(t) + 1
         if not placed:
-            raise ValueError("position hash import overflow")
+            raise ValueError(
+                "position hash import overflow: entry unreachable within "
+                "probe_max tiles — raise pos_cap or probe_max")
 
     bal = np.asarray(canon["bal"]).reshape(-1)
     return {

@@ -110,11 +110,14 @@ int32_t kme_router_route(void* p, int64_t n, const int64_t* action,
   for (int64_t i = 0; i < n; i++) {
     int64_t a = action[i];
     if (a == OP_BUY || a == OP_SELL) {
+      // mutation ORDER matches the Python authority (lane, then
+      // oid_sid, then acct) so partial map state after a CapacityError
+      // is identical either way (ADVICE r4)
       int32_t ln = r.lane(sid[i], &ok);
       if (!ok) return RT_CAP_SYMBOLS;
+      r.oid_sid[oid[i]] = sid[i];
       int32_t ai = r.acct(aid[i], &ok);
       if (!ok) return RT_CAP_ACCOUNTS;
-      r.oid_sid[oid[i]] = sid[i];
       emit(i, a == OP_BUY ? L_BUY : L_SELL, ai, ln);
     } else if (a == OP_CANCEL) {
       auto it = r.oid_sid.find(oid[i]);
@@ -122,10 +125,11 @@ int32_t kme_router_route(void* p, int64_t n, const int64_t* action,
         r.o_rej.push_back(i);
         continue;
       }
-      int32_t ln = r.lane(it->second, &ok);
-      if (!ok) return RT_CAP_SYMBOLS;
+      // Python evaluates _acct before _lane here (argument order)
       int32_t ai = r.acct(aid[i], &ok);
       if (!ok) return RT_CAP_ACCOUNTS;
+      int32_t ln = r.lane(it->second, &ok);
+      if (!ok) return RT_CAP_SYMBOLS;
       emit(i, L_CANCEL, ai, ln);
     } else if (a == OP_CREATE_BALANCE) {
       int32_t ai = r.acct(aid[i], &ok);

@@ -136,6 +136,9 @@ class SeqRouter:
                         f"message {i}: negative-sid trade (sid={sid}) — "
                         f"java ±sid book coupling is outside the device "
                         f"surface; use the native engine")
+                # mutation order (lane, oid_sid, acct) is the authority
+                # contract: the native router replicates it exactly so
+                # partial map state after a CapacityError is identical
                 lane = self._lane(sid)
                 self.oid_sid[oid] = sid
                 emit(i, _TRADE_ACTS[a], self._acct(aid), lane, m, oid,
