@@ -331,8 +331,10 @@ def build_seq_step(cfg: SeqConfig):
 
     def kernel(*args):
         # args: NSMEM message arrays, then aliased state ins, state outs
-        # + out plane, then (hbm_books) 6 VMEM scratch planes + a DMA
-        # semaphore array.
+        # + out plane, then scratch: an SMEM scalar row (cross-section
+        # results — the heavy sections run under pl.when branches so
+        # non-trade messages skip the trade machinery entirely), then
+        # (hbm_books) 6 VMEM scratch planes + a DMA semaphore array.
         (act_s, oidlo_s, oidhi_s, aid_s, price_s, size_s,
          lane_s) = args[:7]
         if JAVA:
@@ -342,9 +344,11 @@ def build_seq_step(cfg: SeqConfig):
         outs = refs[nst:]
         st = dict(zip(KEYS, outs[:nst]))
         out = outs[nst]
+        sm = refs[nst + nst + 1]
+        vr = refs[nst + nst + 2]
         if HBM:
-            scr = dict(zip(BOOK_KEYS, refs[nst + nst + 1:nst + nst + 7]))
-            dsem = refs[nst + nst + 7]
+            scr = dict(zip(BOOK_KEYS, refs[nst + nst + 3:nst + nst + 9]))
+            dsem = refs[nst + nst + 9]
 
         ci = jax.lax.broadcasted_iota(I32, (1, LN), 1)
         # flat slot index over an (NR, 128) side block
@@ -392,49 +396,97 @@ def build_seq_step(cfg: SeqConfig):
 
         def h_find(key):
             """-> (flat entry index or -1, err_flag). Scans tiles from
-            the home tile until the key or an empty slot appears."""
-            def body(c):
-                t, probes, res, done = c
-                krow = st["hk"][pl.ds(t, 1), :]
-                hit = krow == key
-                hidx = jnp.min(jnp.where(hit, ci, BIG))
-                empty = jnp.min(jnp.where(krow == _i(0), ci, BIG))
-                found = hidx < BIG
-                stop = found | (empty < BIG) | (probes + _i(1) >= _i(PROBE))
-                res = jnp.where(found, t * _i(LN) + hidx, res)
-                return ((t + _i(1)) & (CAPMASK >> _i(7)), probes + _i(1),
-                        res, stop)
-
+            the home tile until the key or an empty slot appears. The
+            FIRST tile probes straight-line (the enforced <=50% load
+            factor makes one tile the overwhelmingly common case —
+            and merely entering a while_loop costs ~0.9us on this
+            Mosaic, scripts/exp_loopbody.py); the loop is entered only
+            when tile 0 is full with no hit."""
             t0 = h_home(key)
-            _, probes, res, _ = jax.lax.while_loop(
-                lambda c: ~c[3], body, (t0, _i(0), _i(-1), False))
-            return res, (res < _i(0)) & (probes >= _i(PROBE))
+            krow = st["hk"][pl.ds(t0, 1), :]
+            hit = krow == key
+            hidx = jnp.min(jnp.where(hit, ci, BIG))
+            empty = jnp.min(jnp.where(krow == _i(0), ci, BIG))
+            found = hidx < BIG
+            stop0 = found | (empty < BIG) | (_i(1) >= _i(PROBE))
+            sm[14] = jnp.where(found, t0 * _i(LN) + hidx, _i(-1))
+            sm[15] = ((~found) & (_i(1) >= _i(PROBE))).astype(I32)
+
+            @pl.when(~stop0)
+            def _():
+                def body(c):
+                    t, probes, res, done = c
+                    kr = st["hk"][pl.ds(t, 1), :]
+                    ht = kr == key
+                    hx = jnp.min(jnp.where(ht, ci, BIG))
+                    em = jnp.min(jnp.where(kr == _i(0), ci, BIG))
+                    fnd = hx < BIG
+                    stop = (fnd | (em < BIG)
+                            | (probes + _i(1) >= _i(PROBE)))
+                    res = jnp.where(fnd, t * _i(LN) + hx, res)
+                    return ((t + _i(1)) & (CAPMASK >> _i(7)),
+                            probes + _i(1), res, stop)
+
+                _, probes, res, _ = jax.lax.while_loop(
+                    lambda c: ~c[3], body,
+                    ((t0 + _i(1)) & (CAPMASK >> _i(7)), _i(1),
+                     _i(-1), False))
+                sm[14] = res
+                sm[15] = ((res < _i(0))
+                          & (probes >= _i(PROBE))).astype(I32)
+
+            return sm[14], sm[15] != _i(0)
 
         def h_claim(key):
-            """find-or-insert -> (flat index, err_flag)."""
-            def body(c):
-                t, probes, res, done = c
-                krow = st["hk"][pl.ds(t, 1), :]
-                hit = krow == key
-                hidx = jnp.min(jnp.where(hit, ci, BIG))
-                empty = jnp.min(jnp.where(krow == _i(0), ci, BIG))
-                found = hidx < BIG
-                can_ins = ~found & (empty < BIG)
-                res = jnp.where(found, t * _i(LN) + hidx, res)
-                res = jnp.where(can_ins, t * _i(LN) + empty, res)
-
-                @pl.when(can_ins)
-                def _():
-                    put(st["hk"], t, empty, key)
-
-                stop = found | can_ins | (probes + _i(1) >= _i(PROBE))
-                return ((t + _i(1)) & (CAPMASK >> _i(7)), probes + _i(1),
-                        res, stop)
-
+            """find-or-insert -> (flat index, err_flag). First tile
+            straight-line, loop only on a full missless tile 0 (see
+            h_find)."""
             t0 = h_home(key)
-            _, probes, res, _ = jax.lax.while_loop(
-                lambda c: ~c[3], body, (t0, _i(0), _i(-1), False))
-            return res, res < _i(0)
+            krow = st["hk"][pl.ds(t0, 1), :]
+            hit = krow == key
+            hidx = jnp.min(jnp.where(hit, ci, BIG))
+            empty = jnp.min(jnp.where(krow == _i(0), ci, BIG))
+            found = hidx < BIG
+            can_ins = ~found & (empty < BIG)
+            res0 = jnp.where(found, t0 * _i(LN) + hidx, _i(-1))
+            res0 = jnp.where(can_ins, t0 * _i(LN) + empty, res0)
+            sm[14] = res0
+
+            @pl.when(can_ins)
+            def _():
+                put(st["hk"], t0, empty, key)
+
+            stop0 = found | can_ins | (_i(1) >= _i(PROBE))
+
+            @pl.when(~stop0)
+            def _():
+                def body(c):
+                    t, probes, res, done = c
+                    kr = st["hk"][pl.ds(t, 1), :]
+                    ht = kr == key
+                    hx = jnp.min(jnp.where(ht, ci, BIG))
+                    em = jnp.min(jnp.where(kr == _i(0), ci, BIG))
+                    fnd = hx < BIG
+                    ins = ~fnd & (em < BIG)
+                    res = jnp.where(fnd, t * _i(LN) + hx, res)
+                    res = jnp.where(ins, t * _i(LN) + em, res)
+
+                    @pl.when(ins)
+                    def _():
+                        put(st["hk"], t, em, key)
+
+                    stop = fnd | ins | (probes + _i(1) >= _i(PROBE))
+                    return ((t + _i(1)) & (CAPMASK >> _i(7)),
+                            probes + _i(1), res, stop)
+
+                _, probes, res, _ = jax.lax.while_loop(
+                    lambda c: ~c[3], body,
+                    ((t0 + _i(1)) & (CAPMASK >> _i(7)), _i(1),
+                     _i(-1), False))
+                sm[14] = res
+
+            resv = sm[14]
+            return resv, resv < _i(0)
 
         def pos_key(lane, acc):
             return lane * _i(A) + acc + _i(1)
@@ -487,63 +539,98 @@ def build_seq_step(cfg: SeqConfig):
                      ^ kbl * _i(-1028477387) ^ kbh * _i(69069))
                 return (h >> _i(7)) & (CAPMASK >> _i(7))
 
+            def _jtile(t, kal, kah, kbl, kbh):
+                """probe one tile -> (hidx, empty) lane minima."""
+                srow = st["hstate"][pl.ds(t, 1), :]
+                live = srow == _i(1)
+                eq = (live
+                      & (st["hka_lo"][pl.ds(t, 1), :] == kal)
+                      & (st["hka_hi"][pl.ds(t, 1), :] == kah)
+                      & (st["hkb_lo"][pl.ds(t, 1), :] == kbl)
+                      & (st["hkb_hi"][pl.ds(t, 1), :] == kbh))
+                hidx = jnp.min(jnp.where(eq, ci, BIG))
+                empty = jnp.min(jnp.where(srow == _i(0), ci, BIG))
+                return hidx, empty
+
             def jfind(kal, kah, kbl, kbh):
                 """-> (flat entry or -1, err). Tombstones are passed
-                over; an EMPTY slot ends the probe."""
-                def body(c):
-                    t, probes, res, done = c
-                    srow = st["hstate"][pl.ds(t, 1), :]
-                    live = srow == _i(1)
-                    eq = (live
-                          & (st["hka_lo"][pl.ds(t, 1), :] == kal)
-                          & (st["hka_hi"][pl.ds(t, 1), :] == kah)
-                          & (st["hkb_lo"][pl.ds(t, 1), :] == kbl)
-                          & (st["hkb_hi"][pl.ds(t, 1), :] == kbh))
-                    hidx = jnp.min(jnp.where(eq, ci, BIG))
-                    empty = jnp.min(jnp.where(srow == _i(0), ci, BIG))
-                    found = hidx < BIG
-                    stop = (found | (empty < BIG)
-                            | (probes + _i(1) >= _i(PROBE)))
-                    res = jnp.where(found, t * _i(LN) + hidx, res)
-                    return ((t + _i(1)) & (CAPMASK >> _i(7)),
-                            probes + _i(1), res, stop)
-
+                over; an EMPTY slot ends the probe. First tile probes
+                straight-line (while_loop entry costs ~0.9us on this
+                Mosaic — see h_find)."""
                 t0 = jhome(kal, kah, kbl, kbh)
-                _, probes, res, _ = jax.lax.while_loop(
-                    lambda c: ~c[3], body, (t0, _i(0), _i(-1), False))
-                return res, (res < _i(0)) & (probes >= _i(PROBE))
+                hidx, empty = _jtile(t0, kal, kah, kbl, kbh)
+                found = hidx < BIG
+                stop0 = found | (empty < BIG) | (_i(1) >= _i(PROBE))
+                sm[14] = jnp.where(found, t0 * _i(LN) + hidx, _i(-1))
+                sm[15] = ((~found) & (_i(1) >= _i(PROBE))).astype(I32)
+
+                @pl.when(~stop0)
+                def _():
+                    def body(c):
+                        t, probes, res, done = c
+                        hx, em = _jtile(t, kal, kah, kbl, kbh)
+                        fnd = hx < BIG
+                        stop = (fnd | (em < BIG)
+                                | (probes + _i(1) >= _i(PROBE)))
+                        res = jnp.where(fnd, t * _i(LN) + hx, res)
+                        return ((t + _i(1)) & (CAPMASK >> _i(7)),
+                                probes + _i(1), res, stop)
+
+                    _, probes, res, _ = jax.lax.while_loop(
+                        lambda c: ~c[3], body,
+                        ((t0 + _i(1)) & (CAPMASK >> _i(7)), _i(1),
+                         _i(-1), False))
+                    sm[14] = res
+                    sm[15] = ((res < _i(0))
+                              & (probes >= _i(PROBE))).astype(I32)
+
+                return sm[14], sm[15] != _i(0)
 
             def jslot_for_insert(kal, kah, kbl, kbh):
                 """-> (flat slot, found_live, err): the live match if it
                 exists, else the first reusable (tombstone/empty) slot
-                seen on the probe path."""
-                def body(c):
-                    t, probes, res, reuse, done = c
-                    srow = st["hstate"][pl.ds(t, 1), :]
-                    live = srow == _i(1)
-                    eq = (live
-                          & (st["hka_lo"][pl.ds(t, 1), :] == kal)
-                          & (st["hka_hi"][pl.ds(t, 1), :] == kah)
-                          & (st["hkb_lo"][pl.ds(t, 1), :] == kbl)
-                          & (st["hkb_hi"][pl.ds(t, 1), :] == kbh))
-                    hidx = jnp.min(jnp.where(eq, ci, BIG))
-                    free = jnp.min(jnp.where(srow != _i(1), ci, BIG))
-                    empty = jnp.min(jnp.where(srow == _i(0), ci, BIG))
-                    found = hidx < BIG
-                    reuse = jnp.where((reuse < _i(0)) & (free < BIG),
-                                      t * _i(LN) + free, reuse)
-                    res = jnp.where(found, t * _i(LN) + hidx, res)
-                    stop = (found | (empty < BIG)
-                            | (probes + _i(1) >= _i(PROBE)))
-                    return ((t + _i(1)) & (CAPMASK >> _i(7)),
-                            probes + _i(1), res, reuse, stop)
-
+                seen on the probe path. First tile straight-line (see
+                jfind)."""
                 t0 = jhome(kal, kah, kbl, kbh)
-                _, probes, res, reuse, _ = jax.lax.while_loop(
-                    lambda c: ~c[4], body,
-                    (t0, _i(0), _i(-1), _i(-1), False))
-                found = res >= _i(0)
-                slot = jnp.where(found, res, reuse)
+                srow = st["hstate"][pl.ds(t0, 1), :]
+                hidx, empty = _jtile(t0, kal, kah, kbl, kbh)
+                free = jnp.min(jnp.where(srow != _i(1), ci, BIG))
+                found0 = hidx < BIG
+                reuse0 = jnp.where(free < BIG, t0 * _i(LN) + free,
+                                   _i(-1))
+                res0 = jnp.where(found0, t0 * _i(LN) + hidx, _i(-1))
+                stop0 = (found0 | (empty < BIG)
+                         | (_i(1) >= _i(PROBE)))
+                sm[13] = res0
+                sm[14] = reuse0
+
+                @pl.when(~stop0)
+                def _():
+                    def body(c):
+                        t, probes, res, reuse, done = c
+                        sr = st["hstate"][pl.ds(t, 1), :]
+                        hx, em = _jtile(t, kal, kah, kbl, kbh)
+                        fr = jnp.min(jnp.where(sr != _i(1), ci, BIG))
+                        fnd = hx < BIG
+                        reuse = jnp.where((reuse < _i(0)) & (fr < BIG),
+                                          t * _i(LN) + fr, reuse)
+                        res = jnp.where(fnd, t * _i(LN) + hx, res)
+                        stop = (fnd | (em < BIG)
+                                | (probes + _i(1) >= _i(PROBE)))
+                        return ((t + _i(1)) & (CAPMASK >> _i(7)),
+                                probes + _i(1), res, reuse, stop)
+
+                    _, probes, res, reuse, _ = jax.lax.while_loop(
+                        lambda c: ~c[4], body,
+                        ((t0 + _i(1)) & (CAPMASK >> _i(7)), _i(1),
+                         res0, reuse0, False))
+                    sm[13] = res
+                    sm[14] = reuse
+
+                resv = sm[13]
+                reusev = sm[14]
+                found = resv >= _i(0)
+                slot = jnp.where(found, resv, reusev)
                 return slot, found, slot < _i(0)
 
             def jvals(e):
@@ -817,297 +904,384 @@ def build_seq_step(cfg: SeqConfig):
             def _():
                 put(st["bex"], lr, ll, _i(1))
 
-            # ---------------- TRADE: margin (checkBalance) ------------
-            valid = (limit >= _i(0)) & (limit < _i(126)) & (size > _i(0))
-            signed = jnp.where(is_buy, size, -size)
-            if JAVA:
-                # the reference runs UNVALIDATED fields (no valid gate);
-                # out-of-domain values would corrupt the dense book
-                # layout, so they are a fatal device-envelope error
-                @pl.when(is_trade & ~valid)
+            # ---------------- cross-section scalar defaults -----------
+            # sm: 0 trade_ok, 1 trade_acc, 2 cap_reject, 3 append,
+            #     4 residual echo, 5 nfill, 6/7 tail prev lo/hi,
+            #     8 do_rest, 9 cancel_ok. The heavy sections below run
+            #     under pl.when(act) branches (a NOP/CREATE message
+            #     must not pay for hash probes or book reductions) and
+            #     publish their scalar results here for the epilogue.
+            sm[0] = _i(0)
+            sm[1] = _i(0)
+            sm[2] = _i(0)
+            sm[3] = _i(0)
+            sm[4] = size
+            sm[5] = _i(0)
+            sm[6] = _i(0)
+            sm[7] = _i(0)
+            sm[8] = _i(0)
+            sm[9] = _i(0)
+
+            # ================ TRADE section (pl.when-gated) ===========
+            @pl.when(is_trade)
+            def _trade_section():
+                # -------- margin (checkBalance) -----------------------
+                valid = ((limit >= _i(0)) & (limit < _i(126))
+                         & (size > _i(0)))
+                signed = jnp.where(is_buy, size, -size)
+                if JAVA:
+                    # the reference runs UNVALIDATED fields (no valid
+                    # gate); out-of-domain values would corrupt the
+                    # dense book layout, so they are a fatal
+                    # device-envelope error
+                    @pl.when(~valid)
+                    def _():
+                        set_err(_i(LERR_JAVA_DOMAIN))
+                    e_actor, aerr = jfind(a_rlo, a_rhi, s_rlo, s_rhi)
+                    palo, pahi, pvlo, pvhi = jvals(e_actor)
+                else:
+                    palo, pahi, pvlo, pvhi = pos_get(lane, acc)
+                z64 = (_i(0), _i(0))
+                nsg = _neg64(*_sx(signed))
+                adjlo, adjhi = _sel64(
+                    is_buy,
+                    _max64(_min64((pvlo, pvhi), z64), nsg),
+                    _min64(_max64((pvlo, pvhi), z64), nsg))
+                unit = jnp.where(is_buy, limit, limit - _i(100))
+                risk_lo, risk_hi = _muls64(signed + adjlo, unit)
+                gates = bex_v & bal_ok if JAVA \
+                    else (valid & bex_v & bal_ok)
+                trade_ok = gates & ~_lt64(blo, bhi, risk_lo, risk_hi)
+
+                # -------- phase 1: non-mutating sweep -----------------
+                op_blk = side_blk("bp", lane, opp)
+                os_blk = side_blk("bs", lane, opp)
+                oq_blk = side_blk("bq", lane, opp)
+
+                # working state lives in the vr scratch (rows 0..NR-1:
+                # opp-side sizes, row NR: fill slots, row NR+1: fill
+                # sizes): vector while-carries cost ~2us/iteration on
+                # Mosaic (measured, scripts/exp_devpath.py round 5);
+                # scratch rows + scalar-only carries make an iteration
+                # tens of ns
+                want = jnp.where(trade_ok, size, _i(0))
+
+                @pl.when(want > _i(0))
                 def _():
-                    set_err(_i(LERR_JAVA_DOMAIN))
-                e_actor, aerr = jfind(a_rlo, a_rhi, s_rlo, s_rhi)
-                palo, pahi, pvlo, pvhi = jvals(e_actor)
-            else:
-                palo, pahi, pvlo, pvhi = pos_get(lane, acc)
-            z64 = (_i(0), _i(0))
-            nsg = _neg64(*_sx(signed))
-            adjlo, adjhi = _sel64(
-                is_buy,
-                _max64(_min64((pvlo, pvhi), z64), nsg),
-                _min64(_max64((pvlo, pvhi), z64), nsg))
-            unit = jnp.where(is_buy, limit, limit - _i(100))
-            risk_lo, risk_hi = _muls64(signed + adjlo, unit)
-            gates = bex_v & bal_ok if JAVA else (valid & bex_v & bal_ok)
-            trade_ok = (is_trade & gates
-                        & ~_lt64(blo, bhi, risk_lo, risk_hi))
+                    vr[0:NR, :] = os_blk
+                    z = jnp.zeros((1, LN), I32)
+                    vr[NR:NR + 1, :] = z
+                    vr[NR + 1:NR + 2, :] = z
 
-            # ---------------- TRADE phase 1: non-mutating sweep -------
-            op_blk = side_blk("bp", lane, opp)
-            os_blk = side_blk("bs", lane, opp)
-            oq_blk = side_blk("bq", lane, opp)
+                def sweep(c):
+                    # SELF-CONTAINED body: every vector it touches is a
+                    # ref load or a recomputed iota — closure-captured
+                    # vector VALUES become per-iteration loop inputs in
+                    # Mosaic and cost ~2us/iteration (measured)
+                    remaining, e, ovf, emptied, done = c
+                    fi2 = (jax.lax.broadcasted_iota(I32, (NR, LN), 0)
+                           * _i(LN)
+                           + jax.lax.broadcasted_iota(I32, (NR, LN), 1))
+                    ci2 = jax.lax.broadcasted_iota(I32, (1, LN), 1)
+                    p_blk = side_blk("bp", lane, opp)
+                    q_blk = side_blk("bq", lane, opp)
+                    wsize = vr[0:NR, :]
+                    cross = (wsize > _i(0)) & (
+                        (p_blk - limit) * sgn <= _i(0))
+                    pstar = jnp.min(jnp.where(cross, p_blk * sgn, BIG))
+                    anyc = (pstar < BIG) & (remaining > _i(0))
+                    at = cross & (p_blk * sgn == pstar)
+                    sstar = jnp.min(jnp.where(at, q_blk, BIG))
+                    at2 = at & (q_blk == sstar)
+                    flat = jnp.min(jnp.where(at2, fi2, BIG))
+                    have = MIN32 ^ jnp.max(
+                        jnp.where(fi2 == flat, wsize ^ MIN32, MIN32))
+                    fill = jnp.minimum(remaining, have)
+                    exceed = anyc & (e >= _i(E))
+                    take = anyc & ~exceed
 
-            def sweep(c):
-                wsize, fslot, ffill, remaining, e, ovf, emptied, done = c
-                cross = (wsize > _i(0)) & (
-                    (op_blk - limit) * sgn <= _i(0))
-                pstar = jnp.min(jnp.where(cross, op_blk * sgn, BIG))
-                anyc = (pstar < BIG) & (remaining > _i(0))
-                at = cross & (op_blk * sgn == pstar)
-                sstar = jnp.min(jnp.where(at, oq_blk, BIG))
-                at2 = at & (oq_blk == sstar)
-                flat = jnp.min(jnp.where(at2, fi, BIG))
-                have = pick2(wsize, flat)
-                fill = jnp.minimum(remaining, have)
-                exceed = anyc & (e >= _i(E))
-                take = anyc & ~exceed
-                wsize = jnp.where(take & (fi == flat), wsize - fill, wsize)
-                fslot = jnp.where(take & (ci == e), flat, fslot)
-                ffill = jnp.where(take & (ci == e), fill, ffill)
-                remaining = remaining - jnp.where(take, fill, _i(0))
-                e = e + jnp.where(take, _i(1), _i(0))
-                ovf = ovf | exceed
-                # did the LAST executed trade exhaust its maker exactly?
-                # (the Q2 ghost-trade precondition: the reference loop
-                # re-evaluates its guard only after a maker empties)
-                emptied = jnp.where(take, have - fill == _i(0), emptied)
-                done = (~anyc) | exceed | (remaining == _i(0))
-                return wsize, fslot, ffill, remaining, e, ovf, emptied, done
+                    @pl.when(take)
+                    def _():
+                        vr[0:NR, :] = jnp.where(fi2 == flat, wsize - fill,
+                                                wsize)
+                        fsr = vr[NR:NR + 1, :]
+                        vr[NR:NR + 1, :] = jnp.where(ci2 == e, flat, fsr)
+                        ffr = vr[NR + 1:NR + 2, :]
+                        vr[NR + 1:NR + 2, :] = jnp.where(ci2 == e, fill, ffr)
 
-            want = jnp.where(trade_ok, size, _i(0))
-            init = (os_blk, jnp.zeros((1, LN), I32), jnp.zeros((1, LN), I32),
-                    want, _i(0), False, False, want == _i(0))
-            (wsize, fslot, ffill, residual_t, nfill, ovf_fills,
-             last_emptied, _d) = \
-                jax.lax.while_loop(lambda c: ~c[7], sweep, init)
-            if JAVA:
-                # Q2 (KProcessor.java:237 precedence): with the taker
-                # exhausted, the guard parses to `maker.price >= limit`
-                # regardless of direction — when the last fill emptied
-                # its maker and the NEXT best maker satisfies it, ONE
-                # zero-size trade emits before `maker.size != 0` breaks
-                live_g = wsize > _i(0)
-                gbest = jnp.min(jnp.where(live_g, op_blk * sgn, BIG))
-                g_at = live_g & (op_blk * sgn == gbest)
-                g_ss = jnp.min(jnp.where(g_at, oq_blk, BIG))
-                g_at2 = g_at & (oq_blk == g_ss)
-                gflat = jnp.min(jnp.where(g_at2, fi, BIG))
-                gfc = jnp.where(gbest < BIG, gflat, _i(0))
-                g_price = pick2(op_blk, gfc)
-                ghost = (trade_ok & (residual_t == _i(0)) & last_emptied
-                         & (gbest < BIG) & (g_price >= limit))
-                ghost_ok = ghost & (nfill < _i(E))
+                    remaining = remaining - jnp.where(take, fill, _i(0))
+                    e = e + jnp.where(take, _i(1), _i(0))
+                    ovf = ovf | exceed
+                    # did the LAST executed trade exhaust its maker exactly?
+                    # (the Q2 ghost-trade precondition: the reference loop
+                    # re-evaluates its guard only after a maker empties)
+                    emptied = jnp.where(take, have - fill == _i(0), emptied)
+                    done = (~anyc) | exceed | (remaining == _i(0))
+                    return remaining, e, ovf, emptied, done
 
-                @pl.when(ghost & (nfill >= _i(E)))
+                (residual_t, nfill, ovf_fills, last_emptied, _d) = \
+                    jax.lax.while_loop(lambda c: ~c[4], sweep,
+                                       (want, _i(0), False, False,
+                                        want == _i(0)))
+                wsize = vr[0:NR, :]
+                if JAVA:
+                    # Q2 (KProcessor.java:237 precedence): with the taker
+                    # exhausted, the guard parses to `maker.price >= limit`
+                    # regardless of direction — when the last fill emptied
+                    # its maker and the NEXT best maker satisfies it, ONE
+                    # zero-size trade emits before `maker.size != 0` breaks
+                    live_g = wsize > _i(0)
+                    gbest = jnp.min(jnp.where(live_g, op_blk * sgn, BIG))
+                    g_at = live_g & (op_blk * sgn == gbest)
+                    g_ss = jnp.min(jnp.where(g_at, oq_blk, BIG))
+                    g_at2 = g_at & (oq_blk == g_ss)
+                    gflat = jnp.min(jnp.where(g_at2, fi, BIG))
+                    gfc = jnp.where(gbest < BIG, gflat, _i(0))
+                    g_price = pick2(op_blk, gfc)
+                    ghost = (trade_ok & (residual_t == _i(0)) & last_emptied
+                             & (gbest < BIG) & (g_price >= limit))
+                    ghost_ok = ghost & (nfill < _i(E))
+
+                    @pl.when(ghost & (nfill >= _i(E)))
+                    def _():
+                        set_err(_i(LERR_JAVA_CAP))
+
+                    fsr = vr[NR:NR + 1, :]
+                    vr[NR:NR + 1, :] = jnp.where(ghost_ok & (ci == nfill),
+                                                 gfc, fsr)
+                    ffr = vr[NR + 1:NR + 2, :]
+                    vr[NR + 1:NR + 2, :] = jnp.where(
+                        ghost_ok & (ci == nfill), _i(0), ffr)
+                    nfill = nfill + ghost_ok.astype(I32)
+
+                # ---------------- capacity envelope + Q9 ------------------
+                w_blk = side_blk("bs", lane, side)      # own side sizes
+                if JAVA:
+                    # merged (Q1) books: the sweep just consumed from the
+                    # SAME side the residual rests on — the free-slot
+                    # search and the Q9 bucket tail must see POST-sweep
+                    # sizes (the reference's bitmap bit is unset when the
+                    # bucket empties mid-sweep, so the rest creates a NEW
+                    # bucket with prev = null)
+                    w_blk = jnp.where(is_trade & merged, wsize, w_blk)
+                wp_blk = side_blk("bp", lane, side)
+                wq_blk = side_blk("bq", lane, side)
+                free_flat = jnp.min(jnp.where(w_blk == _i(0), fi, BIG))
+                have_free = free_flat < BIG
+                rest_want = trade_ok & (residual_t > _i(0))
+                ovf_book = rest_want & ~have_free
+                if JAVA:
+                    # unbounded reference stores: hitting a device capacity
+                    # is FATAL (sticky error), never a per-message REJECT
+                    @pl.when(trade_ok & (ovf_fills | ovf_book))
+                    def _():
+                        set_err(_i(LERR_JAVA_CAP))
+
+                    cap_reject = is_trade & False
+                    trade_acc = trade_ok
+                else:
+                    cap_reject = trade_ok & (ovf_fills | ovf_book)
+                    trade_acc = trade_ok & ~cap_reject
+                do_rest = rest_want & trade_acc & have_free
+
+                same_level = (w_blk > _i(0)) & (wp_blk == limit)
+                bucket_nonempty = jnp.max(
+                    jnp.where(same_level, _i(1), _i(0))) == _i(1)
+                smax = jnp.max(jnp.where(same_level, wq_blk, _i(-1)))
+                tail_at = same_level & (wq_blk == smax)
+                tail_flat = jnp.min(jnp.where(tail_at, fi, BIG))
+                tfc = jnp.where(bucket_nonempty, tail_flat, _i(0))
+                tail_lo = pick2(side_blk("bo_lo", lane, side), tfc)
+                tail_hi = pick2(side_blk("bo_hi", lane, side), tfc)
+                append = bucket_nonempty & do_rest
+
+                # ---------------- TRADE phase 2: apply --------------------
+                @pl.when(trade_acc)
                 def _():
-                    set_err(_i(LERR_JAVA_CAP))
+                    # checkBalance debit + adj-write (before the fills, the
+                    # reference's order — final state is order-invariant
+                    # but the position write must precede fill updates of
+                    # the SAME key)
+                    bal_add(acc, *_neg64(risk_lo, risk_hi))
+                    adj_nz = (adjlo != _i(0)) | (adjhi != _i(0))
 
-                fslot = jnp.where(ghost_ok & (ci == nfill), gfc, fslot)
-                ffill = jnp.where(ghost_ok & (ci == nfill), _i(0), ffill)
-                nfill = nfill + ghost_ok.astype(I32)
+                    @pl.when(adj_nz)
+                    def _():
+                        nvlo, nvhi = _add64(pvlo, pvhi, *_neg64(adjlo, adjhi))
+                        if JAVA:
+                            # 3-arg setPosition: the REAL key keeps its
+                            # amount, only `available` moves
+                            # (KProcessor.java:179, exempt from Q11)
+                            jwrite(e_actor, a_rlo, a_rhi, s_rlo, s_rhi,
+                                   palo, pahi, nvlo, nvhi)
+                        else:
+                            e = pos_set(lane, acc, palo, pahi, nvlo, nvhi)
 
-            # ---------------- capacity envelope + Q9 ------------------
-            w_blk = side_blk("bs", lane, side)      # own side sizes
-            if JAVA:
-                # merged (Q1) books: the sweep just consumed from the
-                # SAME side the residual rests on — the free-slot
-                # search and the Q9 bucket tail must see POST-sweep
-                # sizes (the reference's bitmap bit is unset when the
-                # bucket empties mid-sweep, so the rest creates a NEW
-                # bucket with prev = null)
-                w_blk = jnp.where(is_trade & merged, wsize, w_blk)
-            wp_blk = side_blk("bp", lane, side)
-            wq_blk = side_blk("bq", lane, side)
-            free_flat = jnp.min(jnp.where(w_blk == _i(0), fi, BIG))
-            have_free = free_flat < BIG
-            rest_want = trade_ok & (residual_t > _i(0))
-            ovf_book = rest_want & ~have_free
-            if JAVA:
-                # unbounded reference stores: hitting a device capacity
-                # is FATAL (sticky error), never a per-message REJECT
-                @pl.when(trade_ok & (ovf_fills | ovf_book))
-                def _():
-                    set_err(_i(LERR_JAVA_CAP))
+                            @pl.when(e)
+                            def _():
+                                set_err(_i(LERR_HASH_FULL))
 
-                cap_reject = is_trade & False
-                trade_acc = trade_ok
-            else:
-                cap_reject = trade_ok & (ovf_fills | ovf_book)
-                trade_acc = trade_ok & ~cap_reject
-            do_rest = rest_want & trade_acc & have_free
+                    # maker size writeback (size==0 deletes the slot)
+                    side_put("bs", lane, opp, wsize)
 
-            same_level = (w_blk > _i(0)) & (wp_blk == limit)
-            bucket_nonempty = jnp.max(
-                jnp.where(same_level, _i(1), _i(0))) == _i(1)
-            smax = jnp.max(jnp.where(same_level, wq_blk, _i(-1)))
-            tail_at = same_level & (wq_blk == smax)
-            tail_flat = jnp.min(jnp.where(tail_at, fi, BIG))
-            tfc = jnp.where(bucket_nonempty, tail_flat, _i(0))
-            tail_lo = pick2(side_blk("bo_lo", lane, side), tfc)
-            tail_hi = pick2(side_blk("bo_hi", lane, side), tfc)
-            append = bucket_nonempty & do_rest
+                    def apply_fill(e2, _c):
+                        # self-contained: blocks load inside (captured
+                        # vectors become per-iteration loop inputs)
+                        oa_blk = side_blk("ba", lane, opp)
+                        olo_blk = side_blk("bo_lo", lane, opp)
+                        ohi_blk = side_blk("bo_hi", lane, opp)
+                        mp_blk = side_blk("bp", lane, opp)
+                        flat = pick(vr[NR:NR + 1, :], e2)
+                        fill = pick(vr[NR + 1:NR + 2, :], e2)
+                        maid_raw_plane = pick2(oa_blk, flat)
+                        maid = (maid_raw_plane & AMASK) if JAVA \
+                            else maid_raw_plane
+                        mprice = pick2(mp_blk, flat)
+                        p = fill_total + e2
+                        pc = jnp.minimum(p, _i(FB - 1))
 
-            # ---------------- TRADE phase 2: apply --------------------
-            @pl.when(trade_acc)
-            def _():
-                # checkBalance debit + adj-write (before the fills, the
-                # reference's order — final state is order-invariant
-                # but the position write must precede fill updates of
-                # the SAME key)
-                bal_add(acc, *_neg64(risk_lo, risk_hi))
-                adj_nz = (adjlo != _i(0)) | (adjhi != _i(0))
+                        @pl.when(p < _i(FB))
+                        def _():
+                            fill_put(0, pc, pick2(olo_blk, flat))
+                            fill_put(1, pc, pick2(ohi_blk, flat))
+                            fill_put(2, pc, maid)
+                            fill_put(3, pc, mprice)
+                            fill_put(4, pc, fill)
 
-                @pl.when(adj_nz)
-                def _():
-                    nvlo, nvhi = _add64(pvlo, pvhi, *_neg64(adjlo, adjhi))
-                    if JAVA:
-                        # 3-arg setPosition: the REAL key keeps its
-                        # amount, only `available` moves
-                        # (KProcessor.java:179, exempt from Q11)
-                        jwrite(e_actor, a_rlo, a_rhi, s_rlo, s_rhi,
-                               palo, pahi, nvlo, nvhi)
-                    else:
-                        e = pos_set(lane, acc, palo, pahi, nvlo, nvhi)
+                        # maker fill then taker fill (executeTrade order)
+                        msz = jnp.where(is_buy, -fill, fill)
+                        tsz = jnp.where(is_buy, fill, -fill)
+                        if JAVA:
+                            mr, ml = maid >> _i(7), maid & _i(127)
+                            m_rlo = rget(st["araw_lo"], mr, ml)
+                            m_rhi = rget(st["araw_hi"], mr, ml)
+                            me = jfill_one(m_rlo, m_rhi, s_rlo, s_rhi, msz)
+                            te = jfill_one(a_rlo, a_rhi, s_rlo, s_rhi, tsz)
+                        else:
+                            me = fill_one(lane, maid, msz)
+                            te = fill_one(lane, acc, tsz)
+                        # taker credit: int*int wraps at i32 before the
+                        # long add (KProcessor.java:286); maker credit is 0
+                        bal_add(acc, *_sx(tsz * (limit - mprice)))
 
-                        @pl.when(e)
+                        @pl.when(me | te)
                         def _():
                             set_err(_i(LERR_HASH_FULL))
 
-                # maker size writeback (size==0 deletes the slot)
-                side_put("bs", lane, opp, wsize)
+                        return _c
 
-                oa_blk = side_blk("ba", lane, opp)
-                olo_blk = side_blk("bo_lo", lane, opp)
-                ohi_blk = side_blk("bo_hi", lane, opp)
-
-                def apply_fill(e2, _c):
-                    flat = pick(fslot, e2)
-                    fill = pick(ffill, e2)
-                    maid_raw_plane = pick2(oa_blk, flat)
-                    maid = (maid_raw_plane & AMASK) if JAVA \
-                        else maid_raw_plane
-                    mprice = pick2(op_blk, flat)
-                    p = fill_total + e2
-                    pc = jnp.minimum(p, _i(FB - 1))
-
-                    @pl.when(p < _i(FB))
+                    # peeled: fill 0 straight-line, loop only for 2+
+                    @pl.when(nfill > _i(0))
                     def _():
-                        fill_put(0, pc, pick2(olo_blk, flat))
-                        fill_put(1, pc, pick2(ohi_blk, flat))
-                        fill_put(2, pc, maid)
-                        fill_put(3, pc, mprice)
-                        fill_put(4, pc, fill)
+                        apply_fill(_i(0), _i(0))
 
-                    # maker fill then taker fill (executeTrade order)
-                    msz = jnp.where(is_buy, -fill, fill)
-                    tsz = jnp.where(is_buy, fill, -fill)
-                    if JAVA:
-                        mr, ml = maid >> _i(7), maid & _i(127)
-                        m_rlo = rget(st["araw_lo"], mr, ml)
-                        m_rhi = rget(st["araw_hi"], mr, ml)
-                        me = jfill_one(m_rlo, m_rhi, s_rlo, s_rhi, msz)
-                        te = jfill_one(a_rlo, a_rhi, s_rlo, s_rhi, tsz)
-                    else:
-                        me = fill_one(lane, maid, msz)
-                        te = fill_one(lane, acc, tsz)
-                    # taker credit: int*int wraps at i32 before the
-                    # long add (KProcessor.java:286); maker credit is 0
-                    bal_add(acc, *_sx(tsz * (limit - mprice)))
-
-                    @pl.when(me | te)
+                    @pl.when(nfill > _i(1))
                     def _():
-                        set_err(_i(LERR_HASH_FULL))
+                        jax.lax.while_loop(
+                            lambda c: c[0] < nfill,
+                            lambda c: (c[0] + _i(1),
+                                       apply_fill(c[0], c[1])),
+                            (_i(1), _i(0)))
 
-                    return _c
+                    @pl.when(fill_total + nfill > _i(FB))
+                    def _():
+                        set_err(_i(LERR_FILLBUF_FULL))
 
-                jax.lax.while_loop(
-                    lambda c: c[0] < nfill,
-                    lambda c: (c[0] + _i(1), apply_fill(c[0], c[1])),
-                    (_i(0), _i(0)))
+                    # rest the residual
+                    @pl.when(do_rest)
+                    def _():
+                        seqv = rget(st["seqc"], lr, ll)
+                        slot_write("bo_lo", lane, side, free_flat, t_oidlo)
+                        slot_write("bo_hi", lane, side, free_flat, t_oidhi)
+                        ba_val = (acc | (is_buy.astype(I32) << _i(30))) \
+                            if JAVA else acc
+                        slot_write("ba", lane, side, free_flat, ba_val)
+                        slot_write("bp", lane, side, free_flat, limit)
+                        slot_write("bs", lane, side, free_flat, residual_t)
+                        slot_write("bq", lane, side, free_flat, seqv)
+                        put(st["seqc"], lr, ll, seqv + _i(1))
 
-                @pl.when(fill_total + nfill > _i(FB))
-                def _():
-                    set_err(_i(LERR_FILLBUF_FULL))
-
-                # rest the residual
-                @pl.when(do_rest)
-                def _():
-                    seqv = rget(st["seqc"], lr, ll)
-                    slot_write("bo_lo", lane, side, free_flat, t_oidlo)
-                    slot_write("bo_hi", lane, side, free_flat, t_oidhi)
-                    ba_val = (acc | (is_buy.astype(I32) << _i(30))) \
-                        if JAVA else acc
-                    slot_write("ba", lane, side, free_flat, ba_val)
-                    slot_write("bp", lane, side, free_flat, limit)
-                    slot_write("bs", lane, side, free_flat, residual_t)
-                    slot_write("bq", lane, side, free_flat, seqv)
-                    put(st["seqc"], lr, ll, seqv + _i(1))
+                # publish section results for the epilogue
+                sm[0] = trade_ok.astype(I32)
+                sm[1] = trade_acc.astype(I32)
+                sm[2] = cap_reject.astype(I32)
+                sm[3] = append.astype(I32)
+                sm[4] = jnp.where(trade_acc, residual_t, size)
+                sm[5] = jnp.where(trade_acc, nfill, _i(0))
+                sm[6] = tail_lo
+                sm[7] = tail_hi
+                sm[8] = do_rest.astype(I32)
 
             # ---------------- CANCEL ----------------------------------
-            # search both sides for the oid among occupied slots
-            b0 = side_blk("bo_lo", lane, _i(0))
-            b0h = side_blk("bo_hi", lane, _i(0))
-            s0 = side_blk("bs", lane, _i(0))
-            b1 = side_blk("bo_lo", lane, _i(1))
-            b1h = side_blk("bo_hi", lane, _i(1))
-            s1 = side_blk("bs", lane, _i(1))
-            hit0 = (s0 > _i(0)) & (b0 == t_oidlo) & (b0h == t_oidhi)
-            hit1 = (s1 > _i(0)) & (b1 == t_oidlo) & (b1h == t_oidhi)
-            f0 = jnp.min(jnp.where(hit0, fi, BIG))
-            f1 = jnp.min(jnp.where(hit1, fi, BIG))
-            c_side = jnp.where(f0 < BIG, _i(0), _i(1))
-            c_flat = jnp.where(f0 < BIG, f0, f1)
-            hit_any = is_cancel & (c_flat < BIG)
-            cfc = jnp.where(hit_any, c_flat, _i(0))
-            c_ba = pick2(side_blk("ba", lane, c_side), cfc)
-            c_aid = (c_ba & AMASK) if JAVA else c_ba
-            # merged (Q1) books hold both directions in side 0, so java
-            # reads the order's direction from the ba tag bit
-            c_isbuy = ((c_ba >> _i(30)) & _i(1)) == _i(1) if JAVA \
-                else c_side == _i(0)
-            c_price = pick2(side_blk("bp", lane, c_side), cfc)
-            c_size = pick2(side_blk("bs", lane, c_side), cfc)
-            cancel_ok = hit_any & (c_aid == acc)
+            # (pl.when-gated: only cancels pay for the
+            # both-sides oid search)
+            @pl.when(is_cancel)
+            def _cancel_section():
+                # search both sides for the oid among occupied slots
+                b0 = side_blk("bo_lo", lane, _i(0))
+                b0h = side_blk("bo_hi", lane, _i(0))
+                s0 = side_blk("bs", lane, _i(0))
+                b1 = side_blk("bo_lo", lane, _i(1))
+                b1h = side_blk("bo_hi", lane, _i(1))
+                s1 = side_blk("bs", lane, _i(1))
+                hit0 = (s0 > _i(0)) & (b0 == t_oidlo) & (b0h == t_oidhi)
+                hit1 = (s1 > _i(0)) & (b1 == t_oidlo) & (b1h == t_oidhi)
+                f0 = jnp.min(jnp.where(hit0, fi, BIG))
+                f1 = jnp.min(jnp.where(hit1, fi, BIG))
+                c_side = jnp.where(f0 < BIG, _i(0), _i(1))
+                c_flat = jnp.where(f0 < BIG, f0, f1)
+                hit_any = is_cancel & (c_flat < BIG)
+                cfc = jnp.where(hit_any, c_flat, _i(0))
+                c_ba = pick2(side_blk("ba", lane, c_side), cfc)
+                c_aid = (c_ba & AMASK) if JAVA else c_ba
+                # merged (Q1) books hold both directions in side 0, so java
+                # reads the order's direction from the ba tag bit
+                c_isbuy = ((c_ba >> _i(30)) & _i(1)) == _i(1) if JAVA \
+                    else c_side == _i(0)
+                c_price = pick2(side_blk("bp", lane, c_side), cfc)
+                c_size = pick2(side_blk("bs", lane, c_side), cfc)
+                cancel_ok = hit_any & (c_aid == acc)
 
-            @pl.when(cancel_ok)
-            def _():
-                slot_write("bs", lane, c_side, c_flat, _i(0))
-                if JAVA:
-                    # postRemoveAdjustments is Q11-CORRUPTED too
-                    # (KProcessor.java:332, 2-arg setPosition): the
-                    # adj-write lands on the VALUE-as-key target, the
-                    # real (aid, sid) entry stays untouched
-                    e_c, _ce = jfind(a_rlo, a_rhi, s_rlo, s_rhi)
-                    calo, cahi, cvlo, cvhi = jvals(e_c)
-                    cblo, cbhi = _add64(calo, cahi, *_neg64(cvlo, cvhi))
-                    csigned = jnp.where(c_isbuy, c_size, -c_size)
-                    cz = (_i(0), _i(0))
-                    cns = _neg64(*_sx(csigned))
-                    cjlo, cjhi = _sel64(
-                        c_isbuy,
-                        _max64(_min64((cblo, cbhi), cz), cns),
-                        _min64(_max64((cblo, cbhi), cz), cns))
-                    cunit = jnp.where(c_isbuy, c_price,
-                                      c_price - _i(100))
-                    rlo, rhi = _muls64(csigned + cjlo, cunit)
-                    c_nz = (cjlo != _i(0)) | (cjhi != _i(0))
+                @pl.when(cancel_ok)
+                def _():
+                    slot_write("bs", lane, c_side, c_flat, _i(0))
+                    if JAVA:
+                        # postRemoveAdjustments is Q11-CORRUPTED too
+                        # (KProcessor.java:332, 2-arg setPosition): the
+                        # adj-write lands on the VALUE-as-key target, the
+                        # real (aid, sid) entry stays untouched
+                        e_c, _ce = jfind(a_rlo, a_rhi, s_rlo, s_rhi)
+                        calo, cahi, cvlo, cvhi = jvals(e_c)
+                        cblo, cbhi = _add64(calo, cahi, *_neg64(cvlo, cvhi))
+                        csigned = jnp.where(c_isbuy, c_size, -c_size)
+                        cz = (_i(0), _i(0))
+                        cns = _neg64(*_sx(csigned))
+                        cjlo, cjhi = _sel64(
+                            c_isbuy,
+                            _max64(_min64((cblo, cbhi), cz), cns),
+                            _min64(_max64((cblo, cbhi), cz), cns))
+                        cunit = jnp.where(c_isbuy, c_price,
+                                          c_price - _i(100))
+                        rlo, rhi = _muls64(csigned + cjlo, cunit)
+                        c_nz = (cjlo != _i(0)) | (cjhi != _i(0))
 
-                    @pl.when(c_nz)
-                    def _():
-                        nvlo, nvhi = _add64(cvlo, cvhi, cjlo, cjhi)
-                        s2, _f2, ce2 = jslot_for_insert(
-                            calo, cahi, cvlo, cvhi)
-                        jwrite(s2, calo, cahi, cvlo, cvhi,
-                               calo, cahi, nvlo, nvhi)
-
-                        @pl.when(ce2)
+                        @pl.when(c_nz)
                         def _():
-                            set_err(_i(LERR_HASH_FULL))
-                else:
-                    rlo, rhi = release_margin(lane, acc, c_isbuy,
-                                              c_price, c_size)
-                bal_add(acc, rlo, rhi)
+                            nvlo, nvhi = _add64(cvlo, cvhi, cjlo, cjhi)
+                            s2, _f2, ce2 = jslot_for_insert(
+                                calo, cahi, cvlo, cvhi)
+                            jwrite(s2, calo, cahi, cvlo, cvhi,
+                                   calo, cahi, nvlo, nvhi)
+
+                            @pl.when(ce2)
+                            def _():
+                                set_err(_i(LERR_HASH_FULL))
+                    else:
+                        rlo, rhi = release_margin(lane, acc, c_isbuy,
+                                                  c_price, c_size)
+                    bal_add(acc, rlo, rhi)
+
+                sm[9] = cancel_ok.astype(I32)
 
             # ---------------- BARRIERS (payout / remove) --------------
             barrier_do = is_barrier & bex_v if not JAVA \
@@ -1219,9 +1393,16 @@ def build_seq_step(cfg: SeqConfig):
                     _fori32(CAPR, scan_row, _i(0))
 
             # ---------------- outputs + metrics -----------------------
+            t_ok = sm[0] != _i(0)
+            t_acc = sm[1] != _i(0)
+            capr = sm[2] != _i(0)
+            appnd = sm[3]
+            resid_v = sm[4]
+            nf = sm[5]
+            c_ok = sm[9] != _i(0)
             ok = jnp.where(
-                is_trade, trade_acc,
-                jnp.where(is_cancel, cancel_ok,
+                is_trade, t_acc,
+                jnp.where(is_cancel, c_ok,
                           jnp.where(act == _i(L_CREATE), create_ok,
                                     jnp.where(act == _i(L_TRANSFER),
                                               transfer_ok,
@@ -1232,27 +1413,26 @@ def build_seq_step(cfg: SeqConfig):
                                                       is_barrier,
                                                       barrier_do,
                                                       act == _i(L_NOP)))))))
-            flags = (ok.astype(I32) | (cap_reject.astype(I32) << _i(1))
-                     | (append.astype(I32) << _i(2)))
+            flags = (ok.astype(I32) | (capr.astype(I32) << _i(1))
+                     | (appnd << _i(2)))
             out_put(_i(1), m, flags)
-            out_put(_i(1 + BR), m, jnp.where(trade_acc, residual_t, size))
-            out_put(_i(1 + 2 * BR), m, jnp.where(trade_acc, nfill, _i(0)))
-            out_put(_i(1 + 3 * BR), m, tail_lo)
-            out_put(_i(1 + 4 * BR), m, tail_hi)
+            out_put(_i(1 + BR), m, resid_v)
+            out_put(_i(1 + 2 * BR), m, nf)
+            out_put(_i(1 + 3 * BR), m, sm[6])
+            out_put(_i(1 + 4 * BR), m, sm[7])
 
-            filled = jnp.where(trade_acc, size - residual_t, _i(0))
-            nf = jnp.where(trade_acc, nfill, _i(0))
+            filled = jnp.where(t_acc, size - resid_v, _i(0))
             cnt = lambda c: c.astype(I32)
             met = (
                 met[0] + cnt(act != _i(L_NOP)),
-                met[1] + cnt(trade_acc),
+                met[1] + cnt(t_acc),
                 met[2] + nf,
                 met[3] + filled,
-                met[4] + cnt(cap_reject),
-                met[5] + cnt(is_trade & ~trade_ok),
-                met[6] + cnt(do_rest),
-                met[7] + cnt(cancel_ok),
-                met[8] + cnt(is_cancel & ~cancel_ok),
+                met[4] + cnt(capr),
+                met[5] + cnt(is_trade & ~t_ok),
+                met[6] + sm[8],
+                met[7] + cnt(c_ok),
+                met[8] + cnt(is_cancel & ~c_ok),
                 met[9] + cnt(transfer_ok),
                 met[10] + cnt(((act == _i(L_CREATE)) & ~create_ok)
                               | ((act == _i(L_TRANSFER)) & ~transfer_ok)
@@ -1288,9 +1468,10 @@ def build_seq_step(cfg: SeqConfig):
             return pl.BlockSpec(memory_space=pl.ANY)
         return pl.BlockSpec(memory_space=pltpu.VMEM)
 
-    scratches = ([pltpu.VMEM((2 * NR, LN), I32)] * 6
-                 + [pltpu.SemaphoreType.DMA((6,))]) if cfg.hbm_books \
-        else []
+    scratches = [pltpu.SMEM((16,), I32),
+                 pltpu.VMEM((NR + 2, LN), I32)] \
+        + ([pltpu.VMEM((2 * NR, LN), I32)] * 6
+           + [pltpu.SemaphoreType.DMA((6,))] if cfg.hbm_books else [])
 
     def raw_call(state, msgs):
         outs = pl.pallas_call(
@@ -1343,7 +1524,11 @@ def build_seq_scan(cfg: SeqConfig, k: int):
 
 def pack_msgs(cfg: SeqConfig, cols: dict, n: int) -> dict:
     """Columnar router output (numpy, length n <= batch) -> padded
-    (B,) i32 input dict. Padding entries are NOPs."""
+    (B,) i32 input dict. Padding entries are NOPs.
+
+    Single-chunk convenience for tests and __graft_entry__; the serving
+    path packs ALL chunks at once in SeqSession._plan (vectorized twin
+    of this layout — keep the two in sync)."""
     B = cfg.batch
 
     def split64(name, src64):

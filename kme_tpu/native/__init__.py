@@ -190,6 +190,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                               c.POINTER(c.c_uint8), P64]
                            + [c.c_int64] + [P64] * 4 + [c.c_void_p],
                            c.c_int32),
+        # native wire parsing (kme_wire.cpp kme_parse_*)
+        "kme_parse_new": ([], c.c_void_p),
+        "kme_parse_free": ([c.c_void_p], None),
+        "kme_parse_lines": ([c.c_void_p, c.c_char_p, c.c_int64],
+                            c.c_int64),
+        "kme_parse_col": ([c.c_void_p, c.c_int32], P64),
+        "kme_parse_hnext": ([c.c_void_p], c.POINTER(c.c_uint8)),
+        "kme_parse_hprev": ([c.c_void_p], c.POINTER(c.c_uint8)),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)

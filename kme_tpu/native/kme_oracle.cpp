@@ -638,20 +638,86 @@ struct Engine {
          cur.next_has, cur.next, cur.prev_has, cur.prev);
   }
 
-  // the capacity envelope (fixed mode): run, then roll back into a
-  // REJECT when violated — same snapshot discipline as the Python oracle
+  // read-only prediction of the current (fixed-mode) trade's fill
+  // count and whether its residual rests — mirrors add_order/try_match
+  // with NO mutation, so the capacity envelope can reject without the
+  // five-store snapshot (the snapshot cost O(open_orders) per
+  // possibly-violating trade and dominated deep-book judging: ~375s
+  // for the 105k/slots=8192 headline, round 5). Death conditions
+  // return early with no violation: the real path throws identically.
+  void plan_trade(int64_t* fills, bool* rests) const {
+    *fills = 0;
+    *rests = false;
+    if (!(0 <= cur.price && cur.price < 126) || cur.size <= 0) return;
+    bool is_buy = cur.action == OP_BUY;
+    if (!books.count(order_book_key(cur.sid, is_buy))) return;
+    // check_balance outcome, read-only
+    auto bit = balances.find(cur.aid);
+    if (bit == balances.end()) return;
+    int32_t size = jint(jmul(cur.size, is_buy ? 1 : -1));
+    auto pit = positions.find({cur.aid, cur.sid});
+    int64_t available = pit != positions.end() ? pit->second.second : 0;
+    int64_t neg_size = (int64_t)jint(-(int64_t)size);
+    int64_t adj =
+        is_buy ? std::max(std::min(available, (int64_t)0), neg_size)
+               : std::min(std::max(available, (int64_t)0), neg_size);
+    int64_t unit = is_buy ? (int64_t)jint(cur.price)
+                          : (int64_t)jint((int64_t)cur.price - 100);
+    if (bit->second < jmul(jadd(size, adj), unit)) return;
+    // dry sweep (the try_match walk on local copies)
+    int64_t opp_key = order_book_key(cur.sid, !is_buy);
+    auto bkit = books.find(opp_key);
+    if (bkit == books.end()) return;  // real path: Death
+    Book bitmap = bkit->second;
+    int32_t remaining = cur.size;
+    int32_t price_bit =
+        is_buy ? book_min_price(bitmap) : book_max_price(bitmap);
+    if (price_bit != -1) {
+      int64_t bk = bucket_key(opp_key, price_bit);
+      auto buit = buckets.find(bk);
+      if (buit == buckets.end()) return;  // real path: Death
+      int64_t maker_ptr = buit->second.first;
+      auto oit = orders.find(maker_ptr);
+      if (oit == orders.end()) return;  // real path: Death
+      StoredOrder maker = oit->second;
+      while (remaining > 0 && (is_buy ? maker.price <= cur.price
+                                      : maker.price >= cur.price)) {
+        int32_t trade_size = std::min(remaining, maker.size);
+        int32_t maker_left = jint((int64_t)maker.size - trade_size);
+        remaining = jint((int64_t)remaining - trade_size);
+        (*fills)++;
+        if (maker_left != 0) break;
+        if (!maker.next_has) {
+          bitmap = with_bit_unset(bitmap, maker.price);
+          price_bit =
+              is_buy ? book_min_price(bitmap) : book_max_price(bitmap);
+          if (price_bit == -1) break;
+          bk = bucket_key(opp_key, price_bit);
+          buit = buckets.find(bk);
+          if (buit == buckets.end()) return;  // real path: Death
+          maker_ptr = buit->second.first;
+        } else {
+          maker_ptr = maker.next;
+        }
+        oit = orders.find(maker_ptr);
+        if (oit == orders.end()) return;  // real path: Death
+        maker = oit->second;
+      }
+    }
+    *rests = remaining > 0;
+  }
+
+  // the capacity envelope (fixed mode): the O(1) necessary-condition
+  // gate first, then the read-only dry-run decides the violation
+  // EXACTLY — semantics authority is the Python oracle's run-then-
+  // rollback (_process_enveloped), pinned equal by
+  // tests/test_native_oracle.py
   void process_one_enveloped() {
     bool is_trade = cur.action == OP_BUY || cur.action == OP_SELL;
     if (!is_trade || (!has_book_slots && !has_max_fills)) {
       process_one();
       return;
     }
-    // NECESSARY conditions for a violation, checkable in O(1) before
-    // executing: (a) sweeping > max_fills makers needs > max_fills
-    // resting on the opposite side; (b) exceeding book_slots after a
-    // rest needs the side already AT >= book_slots. When neither holds
-    // the snapshot (a full copy of five stores, O(open_orders)) is
-    // skipped — the common case on deep books.
     int64_t opp_act = cur.action == OP_BUY ? OP_SELL : OP_BUY;
     bool possible = false;
     if (has_max_fills && cnt_get(cur.sid, opp_act) > max_fills)
@@ -662,46 +728,31 @@ struct Engine {
       process_one();
       return;
     }
-    Echo orig = cur;
-    auto s_cnt = side_cnt;
-    uint64_t s_seq = pos_seq;
-    auto s_bal = balances;
-    auto s_pos = positions;
-    auto s_ord = orders;
-    auto s_books = books;
-    auto s_buckets = buckets;
-    size_t out_mark = out.size();
-    int64_t lines_mark = cur_lines;
-    process_one();
-    bool violated = false;
-    if (has_max_fills) {
-      int64_t out_recs = 0;
-      // OUT records this message = (lines emitted - 1 IN)
-      out_recs = cur_lines - lines_mark - 1;
-      int64_t ntrades = (out_recs - 1) / 2;
-      violated = ntrades > max_fills;
-    }
+    int64_t wf = 0;
+    bool wr = false;
+    plan_trade(&wf, &wr);
+    bool violated = has_max_fills && wf > max_fills;
     if (!violated && has_book_slots) {
-      auto rit = orders.find(orig.oid);
-      if (rit != orders.end() && rit->second.sid == orig.sid &&
-          rit->second.action == orig.action)
-        violated = cnt_get(orig.sid, orig.action) > book_slots;
+      // the rollback authority checks "order present after the run
+      // with matching sid/action" — which a STALE same-oid resting
+      // order also satisfies when the trade itself does not rest
+      bool stale = false;
+      auto it = orders.find(cur.oid);
+      if (it != orders.end() && it->second.sid == cur.sid &&
+          it->second.action == cur.action)
+        stale = true;
+      int64_t cnt = cnt_get(cur.sid, cur.action);
+      violated = (wr && cnt + 1 > book_slots)
+                 || (!wr && stale && cnt > book_slots);
     }
-    if (!violated) return;
-    side_cnt = std::move(s_cnt);
-    pos_seq = s_seq;
-    balances = std::move(s_bal);
-    positions = std::move(s_pos);
-    orders = std::move(s_ord);
-    books = std::move(s_books);
-    buckets = std::move(s_buckets);
-    out.resize(out_mark);
-    cur_lines = lines_mark;
-    cur = orig;
-    emit("IN", orig.action, orig.oid, orig.aid, orig.sid, orig.price,
-         orig.size, orig.next_has, orig.next, orig.prev_has, orig.prev);
-    emit("OUT", OP_REJECT, orig.oid, orig.aid, orig.sid, orig.price,
-         orig.size, orig.next_has, orig.next, orig.prev_has, orig.prev);
+    if (!violated) {
+      process_one();
+      return;
+    }
+    emit("IN", cur.action, cur.oid, cur.aid, cur.sid, cur.price,
+         cur.size, cur.next_has, cur.next, cur.prev_has, cur.prev);
+    emit("OUT", OP_REJECT, cur.oid, cur.aid, cur.sid, cur.price,
+         cur.size, cur.next_has, cur.next, cur.prev_has, cur.prev);
   }
 };
 

@@ -180,3 +180,226 @@ int32_t kme_recon_wire(
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// kme_parse: newline-separated JSON order messages -> columnar arrays.
+//
+// The input half of the wire boundary (the reference consumes JSON
+// bytes from Kafka and Jackson-binds them onto the Order POJO,
+// KProcessor.java:96, 448-475). Semantics authority: wire.parse_order —
+// creator-bound value fields default to 0 when absent/null, next/prev
+// bind by name (null/absent -> has=0), unknown keys are ignored, fields
+// may appear in any order, last occurrence wins. This parser handles
+// the integer/null/object subset exactly; ANY construct outside it
+// (floats, strings, nested values, syntax errors, ints beyond int64)
+// returns -(line+1) and the caller re-parses the whole buffer through
+// the Python authority so error behavior and coercions stay identical
+// (wire.WireBatch.parse_buffer).
+
+namespace {
+
+struct Parse {
+  int64_t* cols[8] = {};  // action oid aid sid price size next prev
+  uint8_t* hnext = nullptr;
+  uint8_t* hprev = nullptr;
+  int64_t cap = 0, n = 0;
+  ~Parse() {
+    for (auto* c : cols) delete[] c;
+    delete[] hnext;
+    delete[] hprev;
+  }
+};
+
+inline void skip_ws(const char*& p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) p++;
+}
+
+// parse an int64 with JSON number syntax restricted to integers:
+// -?(0|[1-9][0-9]*). Returns false on anything else (incl. overflow).
+inline bool parse_int(const char*& p, const char* end, int64_t* out) {
+  bool neg = false;
+  if (p < end && *p == '-') {
+    neg = true;
+    p++;
+  }
+  if (p >= end || *p < '0' || *p > '9') return false;
+  if (*p == '0' && p + 1 < end && p[1] >= '0' && p[1] <= '9')
+    return false;  // leading zero: invalid JSON
+  uint64_t v = 0;
+  const uint64_t lim = neg ? (uint64_t)1 << 63 : ((uint64_t)1 << 63) - 1;
+  while (p < end && *p >= '0' && *p <= '9') {
+    uint64_t d = (uint64_t)(*p - '0');
+    if (v > (lim - d) / 10) return false;  // beyond int64
+    v = v * 10 + d;
+    p++;
+  }
+  if (p < end && (*p == '.' || *p == 'e' || *p == 'E')) return false;
+  *out = neg ? (int64_t)(0 - v) : (int64_t)v;
+  return true;
+}
+
+// Template fast path: the overwhelmingly common case is the exact
+// Jackson template order_json emits (compact, declaration field order,
+// next/prev always present). One memcmp per literal + digit runs; any
+// deviation falls through to the general object walk above.
+inline bool fast_line(const char* p, const char* end, int64_t* v,
+                      uint8_t* has) {
+  static const struct { const char* lit; int n; } L[8] = {
+      {"{\"action\":", 10}, {",\"oid\":", 7}, {",\"aid\":", 7},
+      {",\"sid\":", 7},     {",\"price\":", 9}, {",\"size\":", 8},
+      {",\"next\":", 8},    {",\"prev\":", 8}};
+  for (int f = 0; f < 8; f++) {
+    if (end - p < L[f].n || std::memcmp(p, L[f].lit, L[f].n))
+      return false;
+    p += L[f].n;
+    if (f >= 6 && end - p >= 4 && !std::memcmp(p, "null", 4)) {
+      p += 4;
+      v[f] = 0;
+      has[f] = 0;
+    } else {
+      if (!parse_int(p, end, &v[f])) return false;
+      has[f] = 1;
+    }
+  }
+  return p < end && *p == '}' && p + 1 == end;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kme_parse_new() { return new Parse(); }
+void kme_parse_free(void* p) { delete static_cast<Parse*>(p); }
+
+const int64_t* kme_parse_col(void* p, int32_t i) {
+  return static_cast<Parse*>(p)->cols[i];
+}
+const uint8_t* kme_parse_hnext(void* p) {
+  return static_cast<Parse*>(p)->hnext;
+}
+const uint8_t* kme_parse_hprev(void* p) {
+  return static_cast<Parse*>(p)->hprev;
+}
+
+// Parse `len` bytes of newline-separated order JSON. Returns the line
+// count on success, -(line+1) on the first line outside the fast
+// subset (caller falls back to the Python authority).
+int64_t kme_parse_lines(void* handle, const char* buf, int64_t len) {
+  Parse& P = *static_cast<Parse*>(handle);
+  // count lines (a trailing newline does not open an empty last line)
+  int64_t nlines = 0;
+  for (int64_t i = 0; i < len; i++)
+    if (buf[i] == '\n') nlines++;
+  if (len > 0 && buf[len - 1] != '\n') nlines++;
+  if (P.cap < nlines) {
+    for (auto*& c : P.cols) {
+      delete[] c;
+      c = new int64_t[nlines];
+    }
+    delete[] P.hnext;
+    delete[] P.hprev;
+    P.hnext = new uint8_t[nlines];
+    P.hprev = new uint8_t[nlines];
+    P.cap = nlines;
+  }
+  P.n = 0;
+  const char* p = buf;
+  const char* bend = buf + len;
+  for (int64_t li = 0; li < nlines; li++) {
+    const char* end = static_cast<const char*>(
+        std::memchr(p, '\n', bend - p));
+    if (!end) end = bend;
+    int64_t v[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    uint8_t has[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    if (fast_line(p, end, v, has)) {
+      for (int f = 0; f < 8; f++) P.cols[f][li] = v[f];
+      P.hnext[li] = has[6];
+      P.hprev[li] = has[7];
+      P.n++;
+      p = end < bend ? end + 1 : end;
+      continue;
+    }
+    for (int f = 0; f < 8; f++) {
+      v[f] = 0;
+      has[f] = 0;
+    }
+    skip_ws(p, end);
+    if (p >= end || *p != '{') return -(li + 1);
+    p++;
+    skip_ws(p, end);
+    bool first = true;
+    while (true) {
+      if (p < end && *p == '}') {
+        p++;
+        break;
+      }
+      if (!first) {
+        if (p >= end || *p != ',') return -(li + 1);
+        p++;
+        skip_ws(p, end);
+      }
+      first = false;
+      if (p >= end || *p != '"') return -(li + 1);
+      p++;
+      const char* k0 = p;
+      while (p < end && *p != '"') {
+        if (*p == '\\') return -(li + 1);  // escaped keys: fall back
+        p++;
+      }
+      if (p >= end) return -(li + 1);
+      int64_t klen = p - k0;
+      p++;
+      skip_ws(p, end);
+      if (p >= end || *p != ':') return -(li + 1);
+      p++;
+      skip_ws(p, end);
+      int fi = -1;
+      switch (klen) {
+        case 3:
+          if (!std::memcmp(k0, "oid", 3)) fi = 1;
+          else if (!std::memcmp(k0, "aid", 3)) fi = 2;
+          else if (!std::memcmp(k0, "sid", 3)) fi = 3;
+          break;
+        case 4:
+          if (!std::memcmp(k0, "size", 4)) fi = 5;
+          else if (!std::memcmp(k0, "next", 4)) fi = 6;
+          else if (!std::memcmp(k0, "prev", 4)) fi = 7;
+          break;
+        case 5:
+          if (!std::memcmp(k0, "price", 5)) fi = 4;
+          break;
+        case 6:
+          if (!std::memcmp(k0, "action", 6)) fi = 0;
+          break;
+      }
+      if (p < end && *p == 'n') {
+        if (end - p < 4 || std::memcmp(p, "null", 4)) return -(li + 1);
+        p += 4;
+        // null: value fields -> 0 (Jackson primitive default),
+        // next/prev -> unset; LAST occurrence wins either way
+        if (fi >= 0) {
+          v[fi] = 0;
+          has[fi] = 0;
+        }
+      } else {
+        int64_t x;
+        if (!parse_int(p, end, &x)) return -(li + 1);
+        if (fi >= 0) {
+          v[fi] = x;
+          has[fi] = 1;
+        }
+      }
+      skip_ws(p, end);
+    }
+    skip_ws(p, end);
+    if (p != end) return -(li + 1);  // trailing garbage
+    if (p < bend) p++;               // consume '\n'
+    for (int f = 0; f < 8; f++) P.cols[f][li] = v[f];
+    P.hnext[li] = has[6];
+    P.hprev[li] = has[7];
+    P.n++;
+  }
+  return P.n;
+}
+
+}  // extern "C"

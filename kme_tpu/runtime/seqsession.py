@@ -28,7 +28,7 @@ from kme_tpu.engine import seq as SQ
 from kme_tpu.runtime import session as _session
 from kme_tpu.runtime.session import LaneEngineError
 from kme_tpu.runtime.sequencer import CapacityError, EnvelopeError
-from kme_tpu.wire import OrderMsg, OutRecord, order_json
+from kme_tpu.wire import OrderMsg, OutRecord, WireBatch, order_json
 
 # register the seq-specific sticky-error name so LaneEngineError renders
 # it (the code space is shared with the lanes engine's LERR_*)
@@ -96,10 +96,12 @@ class SeqRouter:
     def sid_of_lane(self) -> Dict[int, int]:
         return {lane: sid for sid, lane in self.sid_lane.items()}
 
-    def route(self, msgs: Sequence[OrderMsg]):
+    def route(self, msgs):
         """-> (cols dict incl. msg_index, host_reject msg indices)."""
         from kme_tpu.oracle import javalong as jl
 
+        if isinstance(msgs, WireBatch):
+            msgs = msgs.msgs()
         java = self.compat == "java"
         cols = {k: [] for k in ("msg_index", "act", "aid", "price",
                                 "size", "lane", "oid", "aid_raw",
@@ -283,21 +285,31 @@ class NativeSeqRouter:
     def sid_of_lane(self) -> Dict[int, int]:
         return {lane: sid for sid, lane in self.sid_lane.items()}
 
-    def route(self, msgs: Sequence[OrderMsg]):
+    def route(self, msgs):
         import ctypes
 
         n = len(msgs)
         try:
-            raw = {
-                "action": np.fromiter((m.action for m in msgs),
-                                      np.int64, n),
-                "oid": np.fromiter((m.oid for m in msgs), np.int64, n),
-                "aid": np.fromiter((m.aid for m in msgs), np.int64, n),
-                "sid": np.fromiter((m.sid for m in msgs), np.int64, n),
-                "price": np.fromiter((m.price for m in msgs),
-                                     np.int64, n),
-                "size": np.fromiter((m.size for m in msgs), np.int64, n),
-            }
+            if isinstance(msgs, WireBatch):
+                # columnar fast path: zero per-message Python work
+                raw = {f: np.ascontiguousarray(getattr(msgs, f))
+                       for f in ("action", "oid", "aid", "sid",
+                                 "price", "size")}
+            else:
+                raw = {
+                    "action": np.fromiter((m.action for m in msgs),
+                                          np.int64, n),
+                    "oid": np.fromiter((m.oid for m in msgs),
+                                       np.int64, n),
+                    "aid": np.fromiter((m.aid for m in msgs),
+                                       np.int64, n),
+                    "sid": np.fromiter((m.sid for m in msgs),
+                                       np.int64, n),
+                    "price": np.fromiter((m.price for m in msgs),
+                                         np.int64, n),
+                    "size": np.fromiter((m.size for m in msgs),
+                                        np.int64, n),
+                }
         except OverflowError:
             # a field beyond int64: the columnar path cannot carry it
             py = SeqRouter(self.S, self.A)
@@ -315,7 +327,8 @@ class NativeSeqRouter:
             i = int(np.argmax(bad))
             raise EnvelopeError(
                 f"message {i}: price/size outside int32 "
-                f"(price={msgs[i].price}, size={msgs[i].size})")
+                f"(price={int(raw['price'][i])}, "
+                f"size={int(raw['size'][i])})")
         lib = self._lib
         P64 = ctypes.POINTER(ctypes.c_int64)
         rc = lib.kme_router_route(
@@ -390,43 +403,58 @@ class SeqSession:
 
     # ------------------------------------------------------------------
 
-    def _run(self, msgs: Sequence[OrderMsg]):
-        """Route + dispatch (ONE lax.scan jit call over all chunks),
-        then fetch in one concurrent round (headers + adaptive fill
-        prefix; rare overflow slices in a second round). Phase wall
-        times land in self.phases (the bench reads them).
+    def _plan(self, msgs):
+        """Route + pack: columnar router output -> the stacked (K, B)
+        i32 input planes of one scan dispatch. Returns
+        (cols, host_rejects, stacked, cnts, K)."""
+        from kme_tpu.utils import pow2_bucket
+
+        cols, host_rejects = self.router.route(msgs)
+        n = len(cols["act"])
+        B = self.cfg.batch
+        nk = max(-(-n // B), 1)
+        K = pow2_bucket(nk, lo=1)
+        total = K * B
+
+        # vectorized pack over ALL chunks at once (pack_msgs per chunk
+        # was a measurable slice of the plan phase at 100k+ messages);
+        # zero padding is L_NOP by construction
+        def pad32(src):
+            a = np.zeros(total, np.int32)
+            a[:n] = src[:n]
+            return a.reshape(K, B)
+
+        def split64(name, src):
+            v = np.zeros(total, np.int64)
+            v[:n] = src[:n]
+            return {f"{name}_lo": (v & 0xFFFFFFFF).astype(np.uint32)
+                    .astype(np.int32).reshape(K, B),
+                    f"{name}_hi": (v >> 32).astype(np.int32).reshape(K, B)}
+
+        stacked = {f: pad32(cols[f])
+                   for f in ("act", "aid", "price", "size", "lane")}
+        stacked.update(split64("oid", cols["oid"]))
+        if self.cfg.compat == "java":
+            stacked.update(split64("aidr", cols["aid_raw"]))
+            stacked.update(split64("sidr", cols["sid_raw"]))
+            stacked["flags"] = pad32(cols["flags"])
+        cnts = [max(min(B, n - ci * B), 0) for ci in range(K)]
+        return cols, host_rejects, stacked, cnts, K
+
+    def _run(self, msgs):
+        """Plan (route + pack) + dispatch (ONE lax.scan jit call over
+        all chunks), then fetch in one concurrent round (headers +
+        adaptive fill prefix; rare overflow slices in a second round).
+        Phase wall times land in self.phases (the bench reads them).
         Returns (cols, host_rejects, host dict, fills (4, F))."""
         import time
 
         from kme_tpu.utils import async_prefetch, pow2_bucket
 
         t0 = time.perf_counter()
-        cols, host_rejects = self.router.route(msgs)
+        cols, host_rejects, stacked, cnts, K = self._plan(msgs)
         self.phases = {"plan_s": time.perf_counter() - t0}
-        n = len(cols["act"])
-        B = self.cfg.batch
         HR = SQ.hdr_rows(self.cfg)
-        nk = max(-(-n // B), 1)
-        K = pow2_bucket(nk, lo=1)
-        pk_fields = ["act", "aid", "price", "size", "lane",
-                     "oid_lo", "oid_hi"]
-        if self.cfg.compat == "java":
-            pk_fields += ["aidr_lo", "aidr_hi", "sidr_lo", "sidr_hi",
-                          "flags"]
-        stacked = {f: np.zeros((K, B), np.int32) for f in pk_fields}
-        fields = ["act", "aid", "price", "size", "lane", "oid"]
-        if self.cfg.compat == "java":
-            fields += ["aid_raw", "sid_raw", "flags"]
-        cnts = []
-        for ci in range(K):
-            lo = ci * B
-            cnt = max(min(B, n - lo), 0)
-            cnts.append(cnt)
-            if cnt:
-                chunk = {f: cols[f][lo:lo + cnt] for f in fields}
-                packed = SQ.pack_msgs(self.cfg, chunk, cnt)
-                for f in stacked:
-                    stacked[f][ci] = packed[f]
         t0 = time.perf_counter()
         self.state, outp = SQ.build_seq_scan(self.cfg, K)(
             self.state, stacked)
@@ -481,13 +509,16 @@ class SeqSession:
 
     # ------------------------------------------------------------------
 
-    def process_wire_buffer(self, msgs: Sequence[OrderMsg]):
+    def process_wire_buffer(self, msgs):
         """Serving/bench fast path: the full byte-exact record stream as
         ONE utf-8 buffer + line offsets + per-message line counts, built
         by the native C++ reconstructor (kme_tpu/native/kme_wire.cpp).
-        Returns (buf: bytes, line_off: (L+1,) np.int64 incl. end
-        sentinel, msg_lines: (nmsg,) np.int32), or None when the native
-        library is unavailable (callers fall back to process_wire)."""
+        `msgs` may be a WireBatch (zero per-message Python work — the
+        1M/s-class local path) or an OrderMsg sequence (columnarized
+        here, one attribute walk). Returns (buf: bytes, line_off:
+        (L+1,) np.int64 incl. end sentinel, msg_lines: (nmsg,)
+        np.int32), or None when the native library is unavailable or a
+        field exceeds int64 (callers fall back to process_wire)."""
         import ctypes
 
         from kme_tpu.native import load_library
@@ -497,25 +528,22 @@ class SeqSession:
             return None
         if not len(msgs):
             return b"", np.zeros(1, np.int64), np.zeros(0, np.int32)
+        if isinstance(msgs, WireBatch):
+            batch = msgs
+        else:
+            try:
+                batch = WireBatch.from_msgs(msgs)
+            except OverflowError:
+                return None  # beyond-int64 ids ride the Python path
         import time
 
-        cols, host_rejects, host, fills = self._run(msgs)
+        cols, host_rejects, host, fills = self._run(batch)
         t0 = time.perf_counter()
-        nmsg = len(msgs)
-        m_action = np.fromiter((m.action for m in msgs), np.int64, nmsg)
-        m_oid = np.fromiter((m.oid for m in msgs), np.int64, nmsg)
-        m_aid = np.fromiter((m.aid for m in msgs), np.int64, nmsg)
-        m_sid = np.fromiter((m.sid for m in msgs), np.int64, nmsg)
-        m_price = np.fromiter((m.price for m in msgs), np.int64, nmsg)
-        m_size = np.fromiter((m.size for m in msgs), np.int64, nmsg)
-        m_next = np.fromiter(
-            (0 if m.next is None else m.next for m in msgs), np.int64, nmsg)
-        m_hnext = np.fromiter(
-            (m.next is not None for m in msgs), np.uint8, nmsg)
-        m_prev = np.fromiter(
-            (0 if m.prev is None else m.prev for m in msgs), np.int64, nmsg)
-        m_hprev = np.fromiter(
-            (m.prev is not None for m in msgs), np.uint8, nmsg)
+        nmsg = batch.n
+        m_action, m_oid, m_aid = batch.action, batch.oid, batch.aid
+        m_sid, m_price, m_size = batch.sid, batch.price, batch.size
+        m_next, m_hnext = batch.next, batch.hnext
+        m_prev, m_hprev = batch.prev, batch.hprev
 
         mi = cols["msg_index"]
         d_isdev = np.zeros(nmsg, np.uint8)
@@ -586,7 +614,7 @@ class SeqSession:
         self.phases["recon_s"] = time.perf_counter() - t0
         return buf, line_off, msg_lines
 
-    def process_wire(self, msgs: Sequence[OrderMsg]) -> List[List[str]]:
+    def process_wire(self, msgs) -> List[List[str]]:
         if getattr(self, "_use_native_wire", True):
             r = self.process_wire_buffer(msgs)
             if r is not None:
@@ -599,6 +627,8 @@ class SeqSession:
                                 for k in range(nl)])
                     li += nl
                 return out
+        if isinstance(msgs, WireBatch):
+            msgs = msgs.msgs()
         cols, host_rejects, host, fills = self._run(msgs)
         idx_to_aid = self.router.acct_of_idx()
         lane_to_sid = self.router.sid_of_lane()
@@ -667,7 +697,9 @@ class SeqSession:
             out.append(lines)
         return out
 
-    def process(self, msgs: Sequence[OrderMsg]) -> List[List[OutRecord]]:
+    def process(self, msgs) -> List[List[OutRecord]]:
+        if isinstance(msgs, WireBatch):
+            msgs = msgs.msgs()
         cols, host_rejects, host, fills = self._run(msgs)
         idx_to_aid = self.router.acct_of_idx()
         lane_to_sid = self.router.sid_of_lane()

@@ -103,6 +103,116 @@ def dumps_order(o: OrderMsg) -> str:
                       o.next, o.prev)
 
 
+class WireBatch:
+    """Columnar view of a message batch: the zero-Python-loop input
+    format of the serving/bench fast path (SeqSession.process_wire_buffer
+    consumes it directly — router and reconstructor read the columns, so
+    no per-message attribute walk ever runs on the hot path).
+
+    Columns (numpy): action/oid/aid/sid/price/size/next/prev int64,
+    hnext/hprev uint8 (1 = pointer present — Jackson binds next/prev
+    from input too, see module docstring). Values beyond int64 cannot be
+    represented; builders raise OverflowError and callers stay on the
+    OrderMsg-list path (which carries arbitrary ints)."""
+
+    __slots__ = ("n", "action", "oid", "aid", "sid", "price", "size",
+                 "next", "prev", "hnext", "hprev", "_msgs")
+
+    _COLS = ("action", "oid", "aid", "sid", "price", "size", "next",
+             "prev")
+
+    def __init__(self, n, cols, hnext, hprev, msgs=None):
+        self.n = n
+        for f, v in zip(self._COLS, cols):
+            setattr(self, f, v)
+        self.hnext = hnext
+        self.hprev = hprev
+        self._msgs = msgs
+
+    def __len__(self) -> int:
+        return self.n
+
+    @classmethod
+    def from_msgs(cls, msgs) -> "WireBatch":
+        """OrderMsg sequence -> columns (ONE attribute walk; raises
+        OverflowError on values beyond int64)."""
+        import numpy as np
+
+        n = len(msgs)
+        cols = [np.fromiter((m.action for m in msgs), np.int64, n),
+                np.fromiter((m.oid for m in msgs), np.int64, n),
+                np.fromiter((m.aid for m in msgs), np.int64, n),
+                np.fromiter((m.sid for m in msgs), np.int64, n),
+                np.fromiter((m.price for m in msgs), np.int64, n),
+                np.fromiter((m.size for m in msgs), np.int64, n),
+                np.fromiter((0 if m.next is None else m.next
+                             for m in msgs), np.int64, n),
+                np.fromiter((0 if m.prev is None else m.prev
+                             for m in msgs), np.int64, n)]
+        hnext = np.fromiter((m.next is not None for m in msgs),
+                            np.uint8, n)
+        hprev = np.fromiter((m.prev is not None for m in msgs),
+                            np.uint8, n)
+        return cls(n, cols, hnext, hprev,
+                   msgs if isinstance(msgs, list) else list(msgs))
+
+    @classmethod
+    def parse_buffer(cls, buf: bytes) -> "WireBatch":
+        """Newline-separated order JSON -> columns, via the native
+        parser (kme_wire.cpp kme_parse_*) when available; any line
+        outside its integer/null subset re-parses the WHOLE buffer
+        through parse_order so coercions and error behavior are exactly
+        the Python authority's."""
+        import numpy as np
+
+        if not buf:
+            # empty payload = zero messages (the native column pointers
+            # are unallocated at n == 0)
+            return cls(0, [np.zeros(0, np.int64) for _ in range(8)],
+                       np.zeros(0, np.uint8), np.zeros(0, np.uint8), [])
+        lib = None
+        try:
+            from kme_tpu.native import load_library
+
+            lib = load_library()
+        except ImportError:  # pragma: no cover - packaging edge
+            pass
+        if lib is not None:
+            h = lib.kme_parse_new()
+            try:
+                rc = lib.kme_parse_lines(h, buf, len(buf))
+                if rc >= 0:
+                    n = int(rc)
+                    cols = [np.ctypeslib.as_array(
+                        lib.kme_parse_col(h, i), (max(n, 1),))[:n].copy()
+                        for i in range(8)]
+                    hnext = np.ctypeslib.as_array(
+                        lib.kme_parse_hnext(h), (max(n, 1),))[:n].copy()
+                    hprev = np.ctypeslib.as_array(
+                        lib.kme_parse_hprev(h), (max(n, 1),))[:n].copy()
+                    return cls(n, cols, hnext, hprev)
+            finally:
+                lib.kme_parse_free(h)
+        msgs = [parse_order(ln) for ln in buf.split(b"\n") if ln]
+        return cls.from_msgs(msgs)
+
+    def msgs(self) -> list:
+        """Materialize the OrderMsg view (lazily, for oracle/judge
+        paths; the fast path never calls this)."""
+        if self._msgs is None:
+            act, oid, aid = self.action, self.oid, self.aid
+            sid, pr, sz = self.sid, self.price, self.size
+            nx, pv = self.next, self.prev
+            hn, hp = self.hnext, self.hprev
+            self._msgs = [
+                OrderMsg(int(act[i]), int(oid[i]), int(aid[i]),
+                         int(sid[i]), int(pr[i]), int(sz[i]),
+                         int(nx[i]) if hn[i] else None,
+                         int(pv[i]) if hp[i] else None)
+                for i in range(self.n)]
+        return self._msgs
+
+
 @dataclasses.dataclass(frozen=True)
 class OutRecord:
     """One record on the output stream: key is "IN" (pre-processing echo,

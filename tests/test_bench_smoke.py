@@ -150,3 +150,36 @@ def test_capacity_envelope_zipf_stream_parity(cpu_devices):
     flat = [ln for recs in got for ln in recs]
     # the point of the scenario: overflow actually happened
     assert any(ln.startswith('OUT {"action":7') for ln in flat)
+
+
+def test_bench_seq_engine_smoke(cpu_devices, monkeypatch):
+    """The r5 seq bench path at small scale: bytes-in parse, device-path
+    measurement, FULL-stream parity vs the judge, local_orders_per_sec,
+    and the java sub-run fields."""
+    monkeypatch.setenv("KME_BENCH_DEV_REPS", "1")
+    from kme_tpu.benchmarks import bench_seq_engine
+
+    rec = bench_seq_engine(events=1200, symbols=16, accounts=128, seed=3,
+                           zipf_a=1.2, slots=128, max_fills=16, batch=512,
+                           with_java=False)
+    d = rec["detail"]
+    assert rec["metric"] == "orders_per_sec_e2e"
+    assert d["parity_checked_msgs"] == d["events"]
+    assert d["device_path_s"] > 0
+    assert d["local_orders_per_sec"] > 0
+    assert set(("parse_s", "plan_s", "dispatch_s", "fetch_s",
+                "recon_s")) <= set(d)
+
+
+def test_bench_seq_java_smoke(cpu_devices, monkeypatch):
+    """Java-mode seq bench: full-stream parity vs the java judge on the
+    stock harness shape (VMEM-resident deep books at 8 lanes)."""
+    monkeypatch.setenv("KME_BENCH_DEV_REPS", "1")
+    from kme_tpu.benchmarks import bench_seq_engine
+
+    rec = bench_seq_engine(events=600, seed=1, batch=512, compat="java",
+                           with_java=False)
+    d = rec["detail"]
+    assert rec["metric"] == "orders_per_sec_java_exact_tpu"
+    assert d["parity_checked_msgs"] == d["events"]
+    assert d["cap_rejects"] == 0
