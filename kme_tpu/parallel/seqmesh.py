@@ -1,0 +1,330 @@
+"""Multi-chip SEQ fleet: a symbol-sharded set of sequential mega-kernels
+under ONE shard_map, bit-exact vs single-chip serial replay.
+
+The flagship seq engine (kme_tpu/engine/seq.py) is strictly serial on
+one chip. Scale-out follows the reference's partition model (the topic
+is partitioned and Streams instances split partitions — topic.js:18,
+KProcessor.java:59-60), TPU-first: lanes (books, positions, seq
+counters) are SHARDED over the 'symbol' mesh axis — each device runs
+its own seq kernel over its own message subsequence — and balances are
+REPLICATED with exact psum delta-merges at window boundaries.
+
+Why this is bit-exact (the window invariant): within one window every
+ACCOUNT's messages live on a single shard, so an account's balance
+evolves exactly as in serial replay (balance writes are always to the
+acting account: taker debit/credit, transfer, cancel release; maker
+fills credit price 0 and touch only lane-local position state). The
+host planner (plan_windows) closes a window whenever a message's
+account is already bound to a different shard, whenever a shard's
+window capacity fills, and around barriers (PAYOUT/REMOVE credit many
+accounts, so each runs alone in its own window). At a window boundary
+each shard contributes an int64 balance delta with at most one nonzero
+contributor per account — psum is exact, including Java-long wrap.
+
+The sticky error plane is pmax-merged (any shard's envelope error
+surfaces globally; WHICH error wins when several shards fail in one
+window is unspecified, unlike the serial engine's first-error rule —
+the error path aborts the stream either way).
+
+Executed evidence: tests/test_seqmesh.py (bit-exact at shards 1/2/8 on
+a virtual mesh vs the scalar oracle and the single-chip SeqSession),
+tests/test_multihost.py (the same program SPMD across two OS
+processes), and __graft_entry__.dryrun_multichip (the driver's
+multichip artifact).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import numpy as np
+
+import kme_tpu._jaxsetup  # noqa: F401
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kme_tpu.engine import seq as SQ
+from kme_tpu.parallel.mesh import AXIS, build_mesh
+from kme_tpu.runtime.seqsession import SeqSession, make_seq_router
+from kme_tpu.utils import pow2_bucket
+
+# per-shard per-window message capacity (windows close earlier on
+# account conflicts; 128 keeps the padded input planes small)
+WINDOW_CAP = 128
+
+_MSG_FIELDS = ("act", "aid", "price", "size", "lane",
+               "oid_lo", "oid_hi")
+
+
+def make_mesh_state(local_cfg: SQ.SeqConfig, shards: int) -> dict:
+    """Global state pytree: per-shard seq states stacked on the leading
+    row axis for the sharded keys; balances/err replicated."""
+    local = SQ.make_seq_state(local_cfg)
+    out = {}
+    for k, v in local.items():
+        if k in ("bal_lo", "bal_hi", "bal_u", "err"):
+            out[k] = v
+        else:
+            out[k] = jnp.tile(v, (shards, 1))
+    return out
+
+
+def state_specs(local_cfg: SQ.SeqConfig) -> dict:
+    specs = {}
+    for k in SQ.state_keys(local_cfg):
+        if k in ("bal_lo", "bal_hi", "bal_u", "err"):
+            specs[k] = P()
+        else:
+            specs[k] = P(AXIS)
+    return specs
+
+
+def _i64(lo, hi):
+    return ((lo.astype(jnp.int64) & 0xFFFFFFFF)
+            | (hi.astype(jnp.int64) << 32))
+
+
+def _split64(v):
+    lo = v & 0xFFFFFFFF
+    lo = jnp.where(lo >= 1 << 31, lo - (1 << 32), lo).astype(jnp.int32)
+    return lo, (v >> 32).astype(jnp.int32)
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map with varying-mesh-axes checking off: the body contains
+    a pallas_call, whose out_shapes carry no vma annotation."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:  # pragma: no cover - older jax fallback
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except TypeError:  # pragma: no cover - jax without check_vma
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+@functools.lru_cache(maxsize=None)
+def build_seq_mesh_scan(local_cfg: SQ.SeqConfig, shards: int, K: int):
+    """Jitted (state, wins) -> (state, out_planes): a lax.scan over K
+    account-disjoint windows inside ONE shard_map. Each window: the
+    per-shard seq kernel runs its local sub-batch, then balance deltas
+    psum-merge (exact — see module docstring) and the sticky error
+    pmax-merges."""
+    mesh = build_mesh(shards)
+    _, raw_call = SQ.build_seq_step(local_cfg)
+
+    def body(state, win):
+        start_lo = state["bal_lo"]
+        start_hi = state["bal_hi"]
+        start_u = state["bal_u"]
+        st2, outp = raw_call(state, win)
+        old = _i64(start_lo, start_hi)
+        delta = _i64(st2["bal_lo"], st2["bal_hi"]) - old
+        merged = old + jax.lax.psum(delta, AXIS)
+        mlo, mhi = _split64(merged)
+        mu = start_u + jax.lax.psum(st2["bal_u"] - start_u, AXIS)
+        err = jax.lax.pmax(st2["err"], AXIS)
+        st2 = dict(st2, bal_lo=mlo, bal_hi=mhi, bal_u=mu, err=err)
+        # REPLICATE the window's out planes (all_gather over ICI/DCN):
+        # under multi-process meshes the host can only fetch
+        # fully-addressable arrays (tests/test_multihost.py)
+        return st2, jax.lax.all_gather(outp, AXIS)
+
+    def run(state, wins):
+        return jax.lax.scan(body, state, wins, length=K)
+
+    specs = state_specs(local_cfg)
+    win_specs = {f: P(None, AXIS) for f in _MSG_FIELDS}
+    # NO jit-level donation: it composes badly with the kernel's
+    # input_output_aliases (clobbered aliased outputs — the documented
+    # hazard in build_seq_step's NOTE), at the cost of one state copy
+    # per dispatch.
+    sharded = _shard_map(run, mesh, (specs, win_specs),
+                         (specs, P()))
+    return jax.jit(sharded)   # outs: (K, shards, NROWS, 128) replicated
+
+
+class SeqMeshSession(SeqSession):
+    """Sharded drop-in for SeqSession (fixed mode): same process /
+    process_wire / process_wire_buffer surface, state sharded over a
+    `shards`-device mesh. Durability/checkpointing rides the
+    single-chip SeqSession or the lanes mesh — this session is the
+    scale-out serving/validation path (export_state intentionally
+    unsupported)."""
+
+    def __init__(self, cfg: SQ.SeqConfig, shards: int) -> None:
+        if cfg.compat != "fixed":
+            raise ValueError(
+                "sharded seq serving is fixed-mode only (java mode is "
+                "single-chip by Q11's serial semantics, COMPAT.md)")
+        if cfg.hbm_books:
+            raise ValueError("seq mesh uses VMEM books per shard")
+        if cfg.lanes % shards:
+            raise ValueError(f"lanes {cfg.lanes} not divisible by "
+                             f"{shards} shards")
+        self.cfg = cfg
+        self.shards = shards
+        self.local_cfg = SQ.SeqConfig(
+            lanes=cfg.lanes // shards, slots=cfg.slots,
+            accounts=cfg.accounts, max_fills=cfg.max_fills,
+            batch=WINDOW_CAP, pos_cap=cfg.pos_cap,
+            fill_cap=cfg.fill_cap, probe_max=cfg.probe_max)
+        self.S_local = cfg.lanes // shards
+        self.state = make_mesh_state(self.local_cfg, shards)
+        self.router = make_seq_router(cfg.lanes, cfg.accounts)
+        self._metrics = np.zeros(SQ.N_METRICS, np.int64)
+        self._recon = None
+        self.phases = {}
+        self._use_native_wire = True
+        self._ghint = 8
+
+    # -- host planning -------------------------------------------------
+
+    def plan_windows(self, cols):
+        """Columnar routed messages -> (wins dict of (K, shards*Bw) i32,
+        placements list of (window, shard, pos) per routed message,
+        cnts (K, shards) int).
+
+        The planner is host Python (per-message loop): fine for the
+        dryrun/test scale this session targets; a measured multi-chip
+        serving path would move it next to the C++ router
+        (native/kme_router.cpp) like round 4 did for routing."""
+        n = len(cols["act"])
+        Bw = WINDOW_CAP
+        acts = cols["act"]
+        lanes = cols["lane"]
+        aids = cols["aid"]
+        barrier = ((acts == SQ.L_PAYOUT_YES) | (acts == SQ.L_PAYOUT_NO)
+                   | (acts == SQ.L_REMOVE_SYMBOL))
+        laneful = ((acts == SQ.L_BUY) | (acts == SQ.L_SELL)
+                   | (acts == SQ.L_CANCEL) | (acts == SQ.L_ADD_SYMBOL)
+                   | barrier)
+        # only balance-touching acts bind their account to a shard
+        # (ADD_SYMBOL routes with aid=0 but never touches balances)
+        binds = ((acts == SQ.L_BUY) | (acts == SQ.L_SELL)
+                 | (acts == SQ.L_CANCEL) | (acts == SQ.L_CREATE)
+                 | (acts == SQ.L_TRANSFER))
+        windows: List[List[List[int]]] = []  # [w][s] -> routed indices
+        placements = []
+        bound: Dict[int, int] = {}
+        cur = [[] for _ in range(self.shards)]
+
+        def flush():
+            nonlocal cur, bound
+            if any(cur[s] for s in range(self.shards)):
+                windows.append(cur)
+            cur = [[] for _ in range(self.shards)]
+            bound = {}
+
+        for k in range(n):
+            if barrier[k]:
+                # barriers credit many accounts: run alone
+                flush()
+                s = int(lanes[k]) // self.S_local
+                cur[s].append(k)
+                flush()
+                continue
+            a = int(aids[k])
+            if laneful[k]:
+                s = int(lanes[k]) // self.S_local
+            else:
+                s = bound.get(a, a % self.shards)
+            b = bound.get(a) if binds[k] else None
+            if (b is not None and b != s) or len(cur[s]) >= Bw:
+                flush()
+            if binds[k]:
+                bound[a] = s
+            cur[s].append(k)
+        flush()
+
+        K = pow2_bucket(max(len(windows), 1), lo=1)
+        wins = {f: np.zeros((K, self.shards, Bw), np.int32)
+                for f in _MSG_FIELDS}
+        cnts = np.zeros((K, self.shards), np.int32)
+        for w, per in enumerate(windows):
+            for s, idxs in enumerate(per):
+                cnts[w, s] = len(idxs)
+                for p, k in enumerate(idxs):
+                    placements.append((k, w, s, p))
+                    wins["act"][w, s, p] = cols["act"][k]
+                    wins["aid"][w, s, p] = cols["aid"][k]
+                    wins["price"][w, s, p] = cols["price"][k]
+                    wins["size"][w, s, p] = cols["size"][k]
+                    wins["lane"][w, s, p] = (int(cols["lane"][k])
+                                             % self.S_local)
+                    oid = int(cols["oid"][k])
+                    lo = oid & 0xFFFFFFFF
+                    wins["oid_lo"][w, s, p] = np.int32(
+                        lo - (1 << 32) if lo >= 1 << 31 else lo)
+                    wins["oid_hi"][w, s, p] = np.int32(oid >> 32)
+        wins = {f: v.reshape(K, self.shards * WINDOW_CAP)
+                for f, v in wins.items()}
+        placements.sort()
+        return wins, placements, cnts, K
+
+    # -- the SeqSession contract ---------------------------------------
+
+    def _run(self, msgs):
+        import time
+
+        from kme_tpu.runtime.session import LaneEngineError
+
+        t0 = time.perf_counter()
+        cols, host_rejects = self.router.route(msgs)
+        wins, placements, cnts, K = self.plan_windows(cols)
+        self.phases = {"plan_s": time.perf_counter() - t0}
+
+        t0 = time.perf_counter()
+        scan = build_seq_mesh_scan(self.local_cfg, self.shards, K)
+        self.state, outs = scan(self.state, wins)
+        jax.block_until_ready(self.state)
+        self.phases["dispatch_s"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        outs = np.asarray(outs)   # (K, shards, NROWS, 128)
+        HR = SQ.hdr_rows(self.local_cfg)
+        n = len(cols["act"])
+        host = {k: np.zeros(n, dt) for k, dt in
+                (("ok", bool), ("cap_reject", bool), ("append", bool),
+                 ("residual", np.int64), ("nfill", np.int64),
+                 ("prev_oid", np.int64))}
+        groups = {}
+        mets = np.zeros(SQ.N_METRICS, np.int64)
+        for w in range(K):
+            for s in range(self.shards):
+                cnt = int(cnts[w, s])
+                if not cnt:
+                    continue
+                res = SQ.unpack_hdr(self.local_cfg, outs[w, s][:HR], cnt)
+                if res["err"] != SQ.LERR_OK:
+                    raise LaneEngineError(res["err"])
+                ft = res["fill_total"]
+                gr = outs[w, s][HR:HR + 5 * (-(-max(ft, 1) // 128))]
+                groups[(w, s)] = (res, SQ.unpack_fills(gr, ft),
+                                  np.concatenate(
+                                      ([0], np.cumsum(res["nfill"]))))
+                mets += res["metrics"]
+        self._metrics += mets
+        fills_parts = []
+        for k, w, s, p in placements:
+            res, fills_ws, off = groups[(w, s)]
+            for key in host:
+                host[key][k] = res[key][p]
+            if res["nfill"][p]:
+                fills_parts.append(fills_ws[:, off[p]:off[p + 1]])
+        fills = (np.concatenate(fills_parts, axis=1) if fills_parts
+                 else np.zeros((4, 0), np.int64))
+        self.phases["fetch_s"] = time.perf_counter() - t0
+        self.phases["recon_s"] = 0.0
+        return cols, host_rejects, host, fills
+
+    def metrics(self) -> Dict[str, int]:
+        counters = dict(zip(SQ.METRIC_NAMES, self._metrics.tolist()))
+        return counters
+
+    def export_state(self):
+        raise NotImplementedError(
+            "SeqMeshSession has no canonical export; durable serving "
+            "rides the single-chip SeqSession (runtime/checkpoint.py)")

@@ -6,7 +6,11 @@ SPMD across the process boundary — the DCN topology of SURVEY.md §2.3
 ("cross-node comm backend"), validated without real hosts the idiomatic
 JAX way. Usage (spawned by tests/test_multihost.py):
 
-    python distributed_worker.py <coordinator> <nprocs> <pid> <outfile>
+    python distributed_worker.py <coordinator> <nprocs> <pid> <outfile> \
+        [engine]
+
+engine: 'lanes' (sharded sweep session, default) or 'seq' (the
+symbol-sharded seq-kernel fleet, parallel/seqmesh.py).
 """
 
 import hashlib
@@ -20,9 +24,37 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def build_session_and_stream(engine: str):
+    """The (session, stream) pair for an engine — ONE definition shared
+    by the workers and the in-test golden (the sha256 compare requires
+    exact lockstep)."""
+    from kme_tpu.workload import zipf_symbol_stream
+
+    if engine == "seq":
+        from kme_tpu.engine import seq as SQ
+        from kme_tpu.parallel.seqmesh import SeqMeshSession
+
+        msgs = zipf_symbol_stream(900, num_symbols=8, num_accounts=24,
+                                  seed=17, zipf_a=1.0, payout_per_mille=5)
+        ses = SeqMeshSession(
+            SQ.SeqConfig(lanes=8, slots=128, accounts=128, max_fills=16,
+                         pos_cap=1 << 10, probe_max=8), shards=8)
+    else:
+        from kme_tpu.engine.lanes import LaneConfig
+        from kme_tpu.runtime.session import LaneSession
+
+        cfg = LaneConfig(lanes=16, slots=128, accounts=64, max_fills=32,
+                         steps=32)
+        msgs = zipf_symbol_stream(1500, num_symbols=12, num_accounts=24,
+                                  seed=17)
+        ses = LaneSession(cfg, shards=8)   # mesh spans both processes
+    return ses, msgs
+
+
 def main() -> int:
     coordinator, nprocs, pid, outfile = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    engine = sys.argv[5] if len(sys.argv) > 5 else "lanes"
     import jax
 
     jax.distributed.initialize(coordinator_address=coordinator,
@@ -30,15 +62,7 @@ def main() -> int:
     assert jax.device_count() == 4 * nprocs, jax.devices()
     assert jax.process_count() == nprocs
 
-    from kme_tpu.engine.lanes import LaneConfig
-    from kme_tpu.runtime.session import LaneSession
-    from kme_tpu.workload import zipf_symbol_stream
-
-    cfg = LaneConfig(lanes=16, slots=128, accounts=64, max_fills=32,
-                     steps=32)
-    msgs = zipf_symbol_stream(1500, num_symbols=12, num_accounts=24,
-                              seed=17)
-    ses = LaneSession(cfg, shards=8)   # mesh spans both processes
+    ses, msgs = build_session_and_stream(engine)
     out = ses.process_wire(msgs)
     blob = "\n".join(l for ls in out for l in ls).encode()
     digest = hashlib.sha256(blob).hexdigest()

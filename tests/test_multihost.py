@@ -25,7 +25,8 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_mesh_bit_exact():
+@pytest.mark.parametrize("engine", ["lanes", "seq"])
+def test_two_process_mesh_bit_exact(engine):
     port = _free_port()
     coord = f"127.0.0.1:{port}"
     outs = [os.path.join(_HERE, f"_mh_out_{i}.txt") for i in range(2)]
@@ -45,7 +46,7 @@ def test_two_process_mesh_bit_exact():
             os.unlink(outs[i])
         procs.append(subprocess.Popen(
             [sys.executable, os.path.join(_HERE, "distributed_worker.py"),
-             coord, "2", str(i), outs[i]],
+             coord, "2", str(i), outs[i], engine],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True))
     results = []
@@ -61,16 +62,12 @@ def test_two_process_mesh_bit_exact():
         assert rc == 0, f"worker failed rc={rc}\n{err[-3000:]}"
 
     # single-process golden (8 virtual devices in THIS process — the
-    # conftest already forces that topology)
-    from kme_tpu.engine.lanes import LaneConfig
-    from kme_tpu.runtime.session import LaneSession
-    from kme_tpu.workload import zipf_symbol_stream
+    # conftest already forces that topology), from the SAME
+    # session/stream definition the workers use
+    from tests.distributed_worker import build_session_and_stream
 
-    cfg = LaneConfig(lanes=16, slots=128, accounts=64, max_fills=32,
-                     steps=32)
-    msgs = zipf_symbol_stream(1500, num_symbols=12, num_accounts=24,
-                              seed=17)
-    golden = LaneSession(cfg, shards=8).process_wire(msgs)
+    ses, msgs = build_session_and_stream(engine)
+    golden = ses.process_wire(msgs)
     blob = "\n".join(l for ls in golden for l in ls).encode()
     want = f"{hashlib.sha256(blob).hexdigest()} {len(blob)}"
 
