@@ -49,11 +49,9 @@ class MatchService:
             raise ValueError("the lanes engine is fixed-mode only; use "
                              "engine='seq' (stock wire surface), "
                              "'native' or 'oracle' for compat='java'")
-        if engine == "seq" and compat == "java" \
-                and checkpoint_dir is not None:
-            raise ValueError(
-                "java-mode seq sessions have no canonical snapshot yet "
-                "— serve java durably with engine='native' (COMPAT.md)")
+        # java-mode seq sessions checkpoint via the seqjava canonical
+        # form (runtime/javasnap.py) since round 5 — no engine/compat
+        # combination is excluded from durability
         self.broker = broker
         self.engine_kind = engine
         self._compat = compat

@@ -1649,13 +1649,15 @@ def export_java(cfg: SeqConfig, state) -> dict:
 # canonical (lanes-style) state import/export for checkpoint parity
 
 def export_canonical(cfg: SeqConfig, state) -> dict:
-    if cfg.compat == "java":
-        raise NotImplementedError(
-            "java-mode seq state has no canonical snapshot yet — use "
-            "the native engine for durable java serving (COMPAT.md)")
     """Device planes -> the canonical snapshot layout the lanes engine
     checkpoints use (slot_* (S,2,N) i64/i32/bool, flat positions s64,
-    bal s64) so snapshots restore across engines."""
+    bal s64) so snapshots restore across engines. Fixed mode only:
+    java-mode state has its OWN canonical form (128-bit position keys,
+    direction-tagged merged books) in runtime/javasnap.py."""
+    if cfg.compat != "fixed":
+        raise ValueError(
+            "java-mode state has no fixed-layout canonical export — "
+            "snapshot via runtime/javasnap.export_seqjava")
     S, N, A, NR = cfg.lanes, cfg.slots, cfg.accounts, cfg.nr
     h = {k: np.asarray(state[k]) for k in _STATE_KEYS}
 

@@ -36,6 +36,12 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+
+
+class SnapshotCapacityError(ValueError):
+    """The snapshot cannot restore into the requested capacity/engine
+    config (a state migration, not a resume) — callers must NOT
+    silently fall back to a fresh engine."""
 _SKIP_KEYS = ("fillbuf",)
 # arrays whose leading axis is the lane axis (stored in CANONICAL form:
 # user lanes only — the compact path's scrap row is provably all-zero,
@@ -48,6 +54,22 @@ _POS_KEYS = ("pos_amt", "pos_avail")  # flat (S*A,) lane-major
 
 def snapshot_path(ckpt_dir: str, offset: int) -> str:
     return os.path.join(ckpt_dir, f"ckpt-{offset}.npz")
+
+
+def _atomic_savez(ckpt_dir: str, offset: int, payload: dict) -> str:
+    """THE durable snapshot write: tmp file + fsync + atomic rename +
+    directory fsync + prune. Every .npz save path goes through here so
+    the crash-safety sequence cannot fork."""
+    path = snapshot_path(ckpt_dir, offset)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(ckpt_dir)
+    _prune(ckpt_dir, _CKPT_RE)
+    return path
 
 
 def list_snapshots(ckpt_dir: str) -> List[Tuple[int, str]]:
@@ -143,9 +165,12 @@ def _load_file(path: str):
     data = np.load(path)
     meta = json.loads(bytes(data["meta"]).decode())
     # "lanes" and "seq" snapshots share the canonical payload layout
-    # and restore into EITHER engine (cross-engine restore)
-    if meta.get("version") != 1 or meta.get("kind") not in ("lanes",
-                                                            "seq"):
+    # and restore into EITHER engine (cross-engine restore); "seqjava"
+    # is the java-mode canonical form (runtime/javasnap.py), restorable
+    # into SeqSession(compat='java') and convertible to/from the native
+    # engine's dump
+    if meta.get("version") != 1 or meta.get("kind") not in (
+            "lanes", "seq", "seqjava"):
         raise ValueError(f"unsupported snapshot {path}")
     return data, meta
 
@@ -161,6 +186,8 @@ def load_session(ckpt_dir: str, shards: Optional[int] = None,
     for offset, path in list_snapshots(ckpt_dir):
         try:
             return _restore_one(path, shards, width), offset
+        except SnapshotCapacityError:
+            raise          # operator error, not corruption: surface it
         except Exception as e:  # torn/corrupt snapshot: fall back
             import sys
 
@@ -178,6 +205,12 @@ def _restore_one(path: str, shards: Optional[int], width: Optional[int]):
     from kme_tpu.runtime.session import LaneSession
 
     data, meta = _load_file(path)
+    if meta.get("kind") == "seqjava":
+        raise SnapshotCapacityError(
+            "java-mode snapshot cannot restore into the (fixed-mode) "
+            "lanes engine — restore with load_seq_session into "
+            "SeqConfig(compat='java') or convert to the native engine "
+            "(runtime/javasnap.py)")
     if meta.get("kind") == "seq":  # cross-engine restore (canonical)
         mc = meta["cfg"]
         cfg = LaneConfig(lanes=int(mc["lanes"]), slots=int(mc["slots"]),
@@ -257,12 +290,6 @@ def _restore_one(path: str, shards: Optional[int], width: Optional[int]):
     return ses
 
 
-class SnapshotCapacityError(ValueError):
-    """The snapshot cannot restore into the requested capacity config
-    (a state migration, not a resume) — callers must NOT silently fall
-    back to a fresh engine."""
-
-
 def save_seq_session(ckpt_dir: str, session, offset: int) -> str:
     """Snapshot a SeqSession at input offset `offset` in the SAME
     canonical layout as lanes snapshots (slot_* / flat s64 positions /
@@ -271,9 +298,7 @@ def save_seq_session(ckpt_dir: str, session, offset: int) -> str:
     from kme_tpu.engine import seq as SQ
 
     if session.cfg.compat == "java":
-        raise NotImplementedError(
-            "java-mode seq sessions have no canonical snapshot yet — "
-            "use the native engine for durable java serving")
+        return _save_seqjava(ckpt_dir, session, offset)
     os.makedirs(ckpt_dir, exist_ok=True)
     canon = SQ.export_canonical(session.cfg, session.state)
     r = session.router
@@ -297,16 +322,42 @@ def save_seq_session(ckpt_dir: str, session, offset: int) -> str:
     payload["filloff"] = np.zeros(1, np.int64)
     payload["meta"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
-    path = snapshot_path(ckpt_dir, offset)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **payload)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    _fsync_dir(ckpt_dir)
-    _prune(ckpt_dir, _CKPT_RE)
-    return path
+    return _atomic_savez(ckpt_dir, offset, payload)
+
+
+def _save_seqjava(ckpt_dir: str, session, offset: int) -> str:
+    """Snapshot a java-mode SeqSession: the canonical java form
+    (runtime/javasnap.py) — flat 128-bit-key position arrays (Q11
+    garbage keys included: they are parity-relevant state), resting
+    orders with direction tags and bucket seq, balances, and the
+    router id maps."""
+    from kme_tpu.runtime.javasnap import export_seqjava
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    snap = export_seqjava(session)
+    meta = {
+        "version": 1,
+        "kind": "seqjava",
+        "offset": int(offset),
+        "cfg": dataclasses.asdict(session.cfg),
+        "metrics": [int(x) for x in session._metrics],
+        "aid_idx": sorted(snap["aid_idx"].items()),
+        "sid_lane": sorted(snap["sid_lane"].items()),
+        "oid_sid": sorted(snap["oid_sid"].items()),
+    }
+    payload = {k: np.asarray(v) for k, v in snap.items()
+               if k not in ("aid_idx", "sid_lane", "oid_sid")}
+    payload["meta"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    return _atomic_savez(ckpt_dir, offset, payload)
+
+
+def _seqjava_snap_from_file(data, meta) -> dict:
+    snap = {k: np.asarray(data[k]) for k in data.files if k != "meta"}
+    snap["aid_idx"] = {int(k): int(v) for k, v in meta["aid_idx"]}
+    snap["sid_lane"] = {int(k): int(v) for k, v in meta["sid_lane"]}
+    snap["oid_sid"] = {int(k): int(v) for k, v in meta["oid_sid"]}
+    return snap
 
 
 def load_seq_session(ckpt_dir: str, cfg=None):
@@ -334,6 +385,40 @@ def _restore_seq_one(path: str, cfg):
 
     data, meta = _load_file(path)
     explicit_cfg = cfg is not None
+    if meta["kind"] == "seqjava":
+        from kme_tpu.runtime.javasnap import import_seqjava
+
+        if cfg is None:
+            cfg = SQ.SeqConfig(**meta["cfg"])
+        if cfg.compat != "java":
+            raise SnapshotCapacityError(
+                "java-mode snapshot requires SeqConfig(compat='java') "
+                "(or conversion to the native engine, "
+                "runtime/javasnap.py)")
+        if explicit_cfg:
+            # same contract as the fixed path: the device capacity
+            # envelope must not change across a resume (a changed
+            # slots/max_fills alters where the fatal java capacity
+            # error trips mid-stream)
+            n0 = int(meta["cfg"]["slots"])
+            mf = int(meta["cfg"]["max_fills"])
+            if cfg.slots != n0 or cfg.max_fills != mf:
+                raise SnapshotCapacityError(
+                    f"snapshot capacity (slots={n0}, max_fills={mf}) "
+                    f"!= requested (slots={cfg.slots}, max_fills="
+                    f"{cfg.max_fills}) — capacity changes need a "
+                    f"state migration, not a resume")
+        try:
+            ses = import_seqjava(cfg, _seqjava_snap_from_file(data, meta))
+        except ValueError as e:
+            raise SnapshotCapacityError(str(e)) from e
+        if "metrics" in meta:
+            ses._metrics = np.asarray(meta["metrics"], np.int64)
+        return ses
+    if cfg is not None and cfg.compat == "java":
+        raise SnapshotCapacityError(
+            "fixed-mode snapshot cannot restore into a java-mode "
+            "session")
     if cfg is None:
         if meta["kind"] == "seq":
             cfg = SQ.SeqConfig(**meta["cfg"])

@@ -332,3 +332,176 @@ def test_service_crash_resume_full_process_restart(tmp_path):
     want = [ln for lines in per_msg[:150] for ln in lines]
     want += [ln for lines in per_msg[100:] for ln in lines]
     assert got == want
+
+
+# ---------------------------------------------------------------------------
+# java-mode seq checkpoints (runtime/javasnap.py): the 128-bit-key
+# canonical form incl. Q11 garbage keys, and cross-engine restore
+# seq-java <-> native with byte-identical continuation
+# (VERDICT r4 #4; reference: the changelog-restore contract,
+# KProcessor.java:30-49)
+
+def _java_cfg():
+    from kme_tpu.engine import seq as SQ
+
+    return SQ.SeqConfig(lanes=8, slots=512, accounts=128, max_fills=128,
+                        batch=512, pos_cap=1 << 12, probe_max=16,
+                        compat="java")
+
+
+def _java_stream(n=2400, seed=7):
+    from kme_tpu.workload import harness_stream
+
+    return harness_stream(n, seed=seed)
+
+
+def _judge_java(msgs):
+    from kme_tpu.native.oracle import NativeOracleEngine, native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    judge = NativeOracleEngine("java")
+    return judge.process_wire([m.copy() for m in msgs])
+
+
+def test_seqjava_checkpoint_mid_stream_resume(cpu_devices, tmp_path):
+    """Kill/resume mid-stream: process a prefix on a java-mode
+    SeqSession, snapshot, restore into a FRESH session, continue — the
+    combined stream is byte-identical to an uninterrupted judge run,
+    and the garbage-key position store survives exactly."""
+    from kme_tpu.engine import seq as SQ
+    from kme_tpu.runtime.checkpoint import (load_seq_session,
+                                            save_seq_session)
+    from kme_tpu.runtime.seqsession import SeqSession
+
+    cfg = _java_cfg()
+    msgs = _java_stream()
+    cut = 1500
+    ses = SeqSession(cfg)
+    head = ses.process_wire(msgs[:cut])
+    save_seq_session(str(tmp_path), ses, cut)
+
+    ses2, offset = load_seq_session(str(tmp_path))
+    assert offset == cut
+    assert ses2.cfg.compat == "java"
+    # store parity incl. Q11 garbage keys before continuing
+    want_store = SQ.export_java(cfg, ses.state)
+    got_store = SQ.export_java(ses2.cfg, ses2.state)
+    assert got_store["positions"] == want_store["positions"]
+    tail = ses2.process_wire(msgs[cut:])
+    got = [ln for per in head + tail for ln in per]
+    want = [ln for per in _judge_java(msgs) for ln in per]
+    assert got == want
+
+
+def test_seqjava_to_native_continuation(cpu_devices):
+    """seq-java -> native: snapshot the device session, convert to the
+    native engine's dump, continue there — byte-identical to the
+    uninterrupted judge."""
+    from kme_tpu.native.oracle import NativeOracleEngine, native_available
+    from kme_tpu.runtime.javasnap import export_seqjava, to_native_dump
+    from kme_tpu.runtime.seqsession import SeqSession
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    cfg = _java_cfg()
+    msgs = _java_stream(n=2000, seed=13)
+    cut = 1200
+    ses = SeqSession(cfg)
+    head = ses.process_wire(msgs[:cut])
+    dump = to_native_dump(export_seqjava(ses))
+    eng = NativeOracleEngine("java")
+    eng.load_state(dump)
+    tail = eng.process_wire([m.copy() for m in msgs[cut:]])
+    got = [ln for per in head + tail for ln in per]
+    want = [ln for per in _judge_java(msgs) for ln in per]
+    assert got == want
+
+
+def test_native_to_seqjava_continuation(cpu_devices):
+    """native -> seq-java: the native engine's checkpoint dump restores
+    into a java-mode device session which continues byte-identically."""
+    from kme_tpu.native.oracle import NativeOracleEngine, native_available
+    from kme_tpu.runtime.javasnap import from_native_dump, import_seqjava
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    cfg = _java_cfg()
+    msgs = _java_stream(n=2000, seed=29)
+    cut = 1100
+    eng = NativeOracleEngine("java")
+    head = eng.process_wire([m.copy() for m in msgs[:cut]])
+    ses = import_seqjava(cfg, from_native_dump(eng.dump_state()))
+    tail = ses.process_wire(msgs[cut:])
+    got = [ln for per in head + tail for ln in per]
+    want = [ln for per in _judge_java(msgs) for ln in per]
+    assert got == want
+
+
+def test_seqjava_snapshot_refuses_fixed_restore(cpu_devices, tmp_path):
+    """Engine-kind mismatches surface as SnapshotCapacityError /
+    ValueError, never silent fallback."""
+    import pytest
+
+    from kme_tpu.engine import seq as SQ
+    from kme_tpu.runtime.checkpoint import (SnapshotCapacityError,
+                                            load_seq_session,
+                                            save_seq_session)
+    from kme_tpu.runtime.seqsession import SeqSession
+
+    cfg = _java_cfg()
+    ses = SeqSession(cfg)
+    ses.process_wire(_java_stream(n=400))
+    save_seq_session(str(tmp_path), ses, 400)
+    with pytest.raises(SnapshotCapacityError):
+        load_seq_session(str(tmp_path),
+                         SQ.SeqConfig(lanes=8, slots=512, accounts=128,
+                                      max_fills=128, batch=512,
+                                      pos_cap=1 << 12, probe_max=16))
+
+
+def test_seqjava_service_kill_resume(cpu_devices, tmp_path):
+    """Durable java-mode seq SERVING: a MatchService with engine='seq'
+    compat='java' checkpoints mid-stream and a fresh service resumes
+    from the snapshot, producing the byte-exact at-least-once stream."""
+    from kme_tpu.bridge.broker import InProcessBroker
+    from kme_tpu.bridge.provision import provision
+    from kme_tpu.bridge.service import MatchService
+    from kme_tpu.wire import dumps_order
+
+    msgs = _java_stream(n=1400, seed=3)
+    ck = str(tmp_path / "ck")
+    broker = InProcessBroker(str(tmp_path / "log"))
+    provision(broker)
+    for m in msgs[:900]:
+        broker.produce("MatchIn", None, dumps_order(m))
+    kw = dict(engine="seq", compat="java", symbols=8, accounts=128,
+              slots=512, max_fills=128, batch=256, checkpoint_dir=ck,
+              checkpoint_every=256)
+    svc = MatchService(broker, **kw)
+    while svc.step(timeout=0.05):
+        pass
+    n_first = sum(1 for _ in broker.fetch("MatchOut", 0, 10**9))
+    del svc   # "crash" after an arbitrary number of checkpoints
+    for m in msgs[900:]:
+        broker.produce("MatchIn", None, dumps_order(m))
+    svc2 = MatchService(broker, **kw)
+    while svc2.step(timeout=0.05):
+        pass
+    out = [f"{r.key} {r.value}"
+           for r in broker.fetch("MatchOut", 0, 10**9)]
+    groups = _judge_java(msgs)
+    # at-least-once: first-run output for msgs[:900] stands; the
+    # resumed service replays from its snapshot offset k <= 900 and the
+    # replayed+new segment must be byte-exact for msgs[k:]
+    assert out[:n_first] == [ln for per in groups[:900] for ln in per]
+    tail = out[n_first:]
+    ok = any(tail == [ln for per in groups[k:] for ln in per]
+             for k in range(901))
+    assert ok, "replayed stream is not an exact judge segment"

@@ -171,8 +171,11 @@ def test_java_seq_service(tmp_path):
     assert svc.run(max_messages=len(msgs)) == len(msgs)
     got = list(consume_lines(b, follow=False))
     assert got == want
-    # durable java serving stays on the native engine
-    with pytest.raises(ValueError):
-        MatchService(b, engine="seq", compat="java", symbols=8,
-                     accounts=128, slots=256, max_fills=64,
-                     checkpoint_dir=str(tmp_path))
+    # durable java serving works since round 5 (seqjava snapshots,
+    # runtime/javasnap.py) — the constructor must ACCEPT a checkpoint
+    # dir (kill/resume itself is covered by
+    # tests/test_checkpoint.py::test_seqjava_service_kill_resume)
+    svc2 = MatchService(b, engine="seq", compat="java", symbols=8,
+                        accounts=128, slots=256, max_fills=64,
+                        checkpoint_dir=str(tmp_path))
+    assert svc2 is not None
