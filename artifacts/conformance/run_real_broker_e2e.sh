@@ -1,0 +1,95 @@
+#!/usr/bin/env bash
+# One-command real-broker end-to-end: a REAL Kafka (docker compose) +
+# `kme-serve --kafka` + the reference's UNMODIFIED Node harness
+# (exchange_test.js / consumer.js / topic.js), diffing the MatchOut
+# stream against the quirk-exact oracle's replay of the captured
+# MatchIn stream. The harness is unseeded (Math.random), so the oracle
+# replays the ACTUAL MatchIn capture rather than a fixture.
+#
+#   ./run_real_broker_e2e.sh            # full run where prereqs exist
+#
+# Exits 0 on a clean byte-exact diff, 1 on divergence/failure, and
+# 75 (EX_TEMPFAIL) with a SKIP message where docker/node/the reference
+# checkout are unavailable (CI environments without docker skip
+# cleanly — tests/test_conformance.py pins that skip path).
+#
+# Reference run order: reference README.md:10-21 (broker, topic.js,
+# engine, exchange_test.js, consumer.js).
+
+set -u
+HERE="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(cd "$HERE/../.." && pwd)"
+REF_DIR="${REF_DIR:-/root/reference}"
+BOOTSTRAP="${BOOTSTRAP:-localhost:9092}"
+WORK="$(mktemp -d)"
+COMPOSE="docker compose -f $HERE/docker-compose.yml"
+
+skip() { echo "SKIP: $*" >&2; exit 75; }
+fail() { echo "FAIL: $*" >&2; cleanup; exit 1; }
+cleanup() {
+  [ -n "${SERVE_PID:-}" ] && kill "$SERVE_PID" 2>/dev/null
+  $COMPOSE down -v >/dev/null 2>&1
+}
+
+# ---- prereqs (missing => clean SKIP, the only path exercisable in
+# the build environment, which has no docker daemon or node) ----------
+command -v docker >/dev/null 2>&1 || skip "docker not installed"
+docker info >/dev/null 2>&1 || skip "docker daemon unavailable"
+docker compose version >/dev/null 2>&1 || skip "docker compose v2 missing"
+command -v node >/dev/null 2>&1 || skip "node not installed"
+[ -f "$REF_DIR/exchange_test.js" ] || skip "reference checkout not at $REF_DIR (set REF_DIR)"
+python -c "import aiokafka" 2>/dev/null || skip "aiokafka not installed"
+if ! [ -d "$REF_DIR/node_modules/kafkajs" ]; then
+  (cd "$REF_DIR" && npm install kafkajs >/dev/null 2>&1) \
+    || skip "kafkajs not installed in $REF_DIR and npm install failed"
+fi
+
+trap cleanup EXIT
+
+# ---- 1. broker --------------------------------------------------------
+$COMPOSE up -d || fail "compose up"
+for i in $(seq 60); do
+  docker exec conformance-kafka kafka-topics --list \
+      --bootstrap-server "$BOOTSTRAP" >/dev/null 2>&1 && break
+  sleep 1
+  [ "$i" = 60 ] && fail "kafka did not come up"
+done
+
+# ---- 2. topics: the reference's own provisioner, UNMODIFIED ----------
+(cd "$REF_DIR" && node topic.js) || fail "topic.js"
+
+# ---- 3. engine: kme-serve on the REAL broker -------------------------
+(cd "$REPO" && exec python -m kme_tpu.cli serve --kafka "$BOOTSTRAP" \
+    --engine seq --compat java --symbols 8 --accounts 128 \
+    --slots 8192 --max-fills 128 --batch 1024 \
+    --idle-exit 20) &
+SERVE_PID=$!
+
+# ---- 4. load: the reference's UNMODIFIED harness ---------------------
+(cd "$REF_DIR" && node exchange_test.js) || fail "exchange_test.js"
+
+# wait for the engine to drain and idle-exit
+wait "$SERVE_PID" || fail "kme-serve exited non-zero"
+SERVE_PID=""
+
+# ---- 5. capture both topics -------------------------------------------
+docker exec conformance-kafka kafka-console-consumer \
+    --bootstrap-server "$BOOTSTRAP" --topic MatchIn --from-beginning \
+    --timeout-ms 10000 > "$WORK/matchin.jsonl" 2>/dev/null
+docker exec conformance-kafka kafka-console-consumer \
+    --bootstrap-server "$BOOTSTRAP" --topic MatchOut --from-beginning \
+    --timeout-ms 10000 --property print.key=true \
+    --property key.separator=' ' > "$WORK/matchout.txt" 2>/dev/null
+[ -s "$WORK/matchin.jsonl" ] || fail "no MatchIn records captured"
+
+# ---- 6. oracle replay + diff -----------------------------------------
+(cd "$REPO" && python -m kme_tpu.cli oracle --compat java) \
+    < "$WORK/matchin.jsonl" > "$WORK/expected.txt" || fail "oracle replay"
+if diff -u "$WORK/expected.txt" "$WORK/matchout.txt" > "$WORK/diff.txt"; then
+  echo "OK: MatchOut byte-exact vs the oracle replay" \
+       "($(wc -l < "$WORK/matchout.txt") records)"
+  exit 0
+fi
+echo "DIVERGED — first lines:" >&2
+head -20 "$WORK/diff.txt" >&2
+exit 1

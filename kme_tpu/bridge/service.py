@@ -123,6 +123,24 @@ class MatchService:
         from kme_tpu.runtime import checkpoint as ck
 
         if engine == "seq":
+            if compat == "java":
+                # the previous incarnation may have DEGRADED to the
+                # native engine mid-stream (a barrier left the java
+                # device surface, _degrade_to_native) and checkpointed
+                # there — the NEWEST snapshot across kinds wins; the
+                # .npz offsets are listed WITHOUT restoring so the
+                # common degraded-restart path never pays the device
+                # import
+                seq_snaps = ck.list_snapshots(self.checkpoint_dir)
+                seq_off = seq_snaps[0][0] if seq_snaps else -1
+                nat, noff = ck.load_native(self.checkpoint_dir)
+                if nat is not None and nat.java and noff > seq_off:
+                    self._native = nat
+                    self.offset = self._last_ckpt_offset = noff
+                    print(f"kme-serve: resumed DEGRADED (native) "
+                          f"java continuation at offset {noff}",
+                          file=sys.stderr)
+                    return True
             ses, offset = ck.load_seq_session(self.checkpoint_dir,
                                               self._seq_cfg())
             if ses is None:
@@ -268,20 +286,28 @@ class MatchService:
                 msgs.append(m)
         if msgs:
             if self._native is not None:
-                # byte-faithful death handling: forward every completed
-                # message's records, THEN die like the reference thread
-                out, exc = self._native.process_wire_partial(msgs)
-                for lines in out:
-                    for ln in lines:
-                        key, _, value = ln.partition(" ")
-                        self.broker.produce(TOPIC_OUT, key, value)
-                if exc is not None:
-                    raise exc
+                self._native_produce(msgs)
             elif self._session is not None:
-                for lines in self._session.process_wire(msgs):
-                    for ln in lines:
-                        key, _, value = ln.partition(" ")
-                        self.broker.produce(TOPIC_OUT, key, value)
+                try:
+                    out = self._session.process_wire(msgs)
+                except Exception as e:
+                    from kme_tpu.runtime.seqsession import \
+                        UnsupportedJavaOp
+
+                    if not isinstance(e, UnsupportedJavaOp):
+                        raise
+                    # a java-mode stream left the device surface
+                    # (barrier / negative-sid symbol, COMPAT.md): the
+                    # router raises BEFORE any device mutation, so the
+                    # session's state converts losslessly to the native
+                    # engine (runtime/javasnap.py) and serving
+                    # continues there — the batch replays on the
+                    # native engine from the same state
+                    self._degrade_to_native(str(e))
+                    self._native_produce(msgs)
+                    out = None
+                if out is not None:
+                    self._produce_lines(out)
             else:
                 from kme_tpu.wire import dumps_order
 
@@ -294,6 +320,45 @@ class MatchService:
         self.offset = recs[-1].offset + 1
         self._maybe_checkpoint()
         return len(recs)
+
+    def _produce_lines(self, out) -> None:
+        for lines in out:
+            for ln in lines:
+                key, _, value = ln.partition(" ")
+                self.broker.produce(TOPIC_OUT, key, value)
+
+    def _native_produce(self, msgs) -> None:
+        # byte-faithful death handling: forward every completed
+        # message's records, THEN die like the reference thread
+        out, exc = self._native.process_wire_partial(msgs)
+        self._produce_lines(out)
+        if exc is not None:
+            raise exc
+
+    def _degrade_to_native(self, reason: str) -> None:
+        """One-way engine degradation for java-mode streams that leave
+        the device surface (COMPAT.md): the seq session's state
+        converts losslessly to the native engine (runtime/javasnap.py)
+        and serving continues there — the full java wire surface incl.
+        barriers. Checkpoints switch to native snapshots; a restart
+        resumes the degraded continuation (_try_resume)."""
+        from kme_tpu.native.oracle import NativeOracleEngine, \
+            native_available
+        from kme_tpu.runtime.javasnap import export_seqjava, \
+            to_native_dump
+
+        if not native_available():
+            raise RuntimeError(
+                f"java stream left the device surface ({reason}) and "
+                f"the native engine is unavailable to degrade onto — "
+                f"serve this stream with engine='native' or 'oracle'")
+        print(f"kme-serve: java stream left the device surface "
+              f"({reason}); continuing on the native engine",
+              file=sys.stderr)
+        eng = NativeOracleEngine("java")
+        eng.load_state(to_native_dump(export_seqjava(self._session)))
+        self._native = eng
+        self._session = None
 
     def metrics(self) -> Optional[dict]:
         """On-device counters+gauges (lanes engine; None for oracle)."""
@@ -315,8 +380,19 @@ class MatchService:
         the PROCESS froze or died (the reference delegates liveness to
         Kafka's group-membership heartbeats, KProcessor.java:59-60 via
         the Streams library)."""
+        import os
         import threading
         import time
+
+        # fault injection (tests/test_supervise.py): when
+        # KME_TEST_STALL_ONCE names a flag file that does not exist yet,
+        # the loop freezes (tick stops advancing) after
+        # KME_TEST_STALL_AT messages while the heartbeat THREAD stays
+        # alive — the exact hang shape the supervisor's stall branch
+        # exists to catch. The flag file is created before freezing so
+        # the restarted incarnation runs clean (stall exactly once).
+        stall_once = os.environ.get("KME_TEST_STALL_ONCE")
+        stall_at = int(os.environ.get("KME_TEST_STALL_AT", "100"))
 
         seen = 0
         beat_stop = None
@@ -354,6 +430,11 @@ class MatchService:
                     seen += n
                     if health_file is not None:
                         seen_box[0] = seen
+                if (stall_once and seen >= stall_at
+                        and not os.path.exists(stall_once)):
+                    open(stall_once, "w").close()
+                    while True:   # frozen tick, live heartbeat thread
+                        time.sleep(0.5)
         finally:
             if beat_stop is not None:
                 beat_stop.set()

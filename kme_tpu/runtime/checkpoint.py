@@ -42,6 +42,8 @@ class SnapshotCapacityError(ValueError):
     """The snapshot cannot restore into the requested capacity/engine
     config (a state migration, not a resume) — callers must NOT
     silently fall back to a fresh engine."""
+
+
 _SKIP_KEYS = ("fillbuf",)
 # arrays whose leading axis is the lane axis (stored in CANONICAL form:
 # user lanes only — the compact path's scrap row is provably all-zero,
@@ -125,16 +127,7 @@ def save_session(ckpt_dir: str, session, offset: int) -> str:
         payload[k] = v
     payload["meta"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
-    path = snapshot_path(ckpt_dir, offset)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **payload)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    _fsync_dir(ckpt_dir)  # make the rename itself durable
-    _prune(ckpt_dir, _CKPT_RE)
-    return path
+    return _atomic_savez(ckpt_dir, offset, payload)
 
 
 def _fsync_dir(d: str) -> None:

@@ -381,6 +381,12 @@ def from_native_dump(text: str) -> dict:
         ptr, guard = first, 0
         while True:
             act, aid, sid, price, size, nh, nxt = orders[ptr]
+            if sid < 0:
+                raise ValueError(
+                    f"resting order with negative sid {sid} — the ±sid "
+                    f"book coupling is outside the seq device surface; "
+                    f"this state must stay on the native engine "
+                    f"(COMPAT.md)")
             if not (0 <= price < 126):
                 raise ValueError(
                     f"resting price {price} outside the seq device "

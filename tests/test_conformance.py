@@ -21,8 +21,12 @@ def test_pack_matches_regeneration(tmp_path):
     spec.loader.exec_module(mod)
     mod.generate(str(tmp_path))
     packed = conformance_dir()
+    from kme_tpu.native.oracle import native_available
+
     names = sorted(f for f in os.listdir(packed)
-                   if f.endswith((".jsonl", ".txt")))
+                   if f.endswith((".jsonl", ".txt"))
+                   and (native_available()
+                        or not f.endswith(".store.txt")))
     assert names, "conformance pack is empty"
     regen = sorted(f for f in os.listdir(str(tmp_path)))
     assert names == regen
@@ -30,3 +34,30 @@ def test_pack_matches_regeneration(tmp_path):
         with open(os.path.join(packed, f), "rb") as a, \
                 open(os.path.join(str(tmp_path), f), "rb") as b:
             assert a.read() == b.read(), f"{f} drifted from regeneration"
+
+
+def test_real_broker_e2e_script_skip_path():
+    """The one-command real-broker e2e (run_real_broker_e2e.sh): where
+    docker/node/the reference exist it runs broker + kme-serve --kafka
+    + the UNMODIFIED Node harness and diffs MatchOut against the oracle
+    replay; in THIS environment it must skip cleanly with exit 75
+    (EX_TEMPFAIL) — never half-run or fail."""
+    import os
+    import subprocess
+
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", "conformance",
+        "run_real_broker_e2e.sh")
+    import pytest
+
+    try:
+        # generous budget: a docker-capable host pulls images, waits
+        # for kafka, drives the harness and drains the engine
+        r = subprocess.run(["bash", script], capture_output=True,
+                           text=True, timeout=1200)
+    except subprocess.TimeoutExpired:
+        pytest.fail("real-broker e2e script hung (>20min)")
+    if r.returncode == 0:
+        return  # a docker-capable environment ran the real thing
+    assert r.returncode == 75, (r.returncode, r.stderr[-500:])
+    assert "SKIP:" in r.stderr

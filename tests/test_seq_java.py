@@ -179,3 +179,39 @@ def test_java_seq_service(tmp_path):
                         accounts=128, slots=256, max_fills=64,
                         checkpoint_dir=str(tmp_path))
     assert svc2 is not None
+
+
+def test_java_seq_service_degrades_on_barrier(tmp_path):
+    """COMPAT.md closure: a java-mode stream that hits a REAL barrier
+    (PAYOUT opcode — outside the device surface, Q3-Q6) mid-stream.
+    The service converts the seq session's state to the native engine
+    (runtime/javasnap.py) and continues there; the full MatchOut
+    stream is byte-exact vs an uninterrupted java-oracle run."""
+    from kme_tpu.bridge.broker import InProcessBroker
+    from kme_tpu.bridge.consume import consume_lines
+    from kme_tpu.bridge.provision import provision
+    from kme_tpu.bridge.service import MatchService
+    from kme_tpu.wire import OrderMsg, dumps_order
+    from kme_tpu import opcodes as op
+
+    msgs = harness_stream(600, seed=21)
+    # inject a REAL payout barrier (the harness's own payouts carry the
+    # CANCEL opcode, Q5) on an ABSENT book — a payout on a non-empty
+    # book is a ReferenceHang (Q4), which no engine may survive; the
+    # absent-book payout is the processable barrier shape
+    barrier = OrderMsg(action=op.PAYOUT, sid=99, size=3)
+    mixed = msgs[:400] + [barrier] + msgs[400:]
+    ora = OracleEngine("java")
+    want = [r.wire() for m in mixed for r in ora.process(m.copy())]
+
+    b = InProcessBroker()
+    provision(b)
+    for m in mixed:
+        b.produce("MatchIn", None, dumps_order(m))
+    svc = MatchService(b, engine="seq", compat="java", batch=64,
+                       symbols=8, accounts=128, slots=256, max_fills=64)
+    assert svc.run(max_messages=len(mixed)) == len(mixed)
+    assert svc._native is not None and svc._session is None, \
+        "service should have degraded to the native engine"
+    got = list(consume_lines(b, follow=False))
+    assert got == want

@@ -148,3 +148,76 @@ def test_supervised_kill9_resume_byte_exact(tmp_path):
     assert okshape, (
         f"stream is not an at-least-once prefix+replay composition "
         f"({len(got)} lines)")
+
+
+@pytest.mark.slow
+def test_supervised_stall_restart_byte_exact(tmp_path):
+    """The HANG branch: the serve loop freezes mid-stream (tick stops
+    advancing) while the heartbeat THREAD stays alive — process-exit
+    and stale-mtime detection cannot fire. The supervisor must detect
+    the frozen tick (--stall-after), restart from the newest
+    checkpoint, and the completed stream must be the at-least-once
+    prefix+replay shape, byte-exact. Reference analog: Streams
+    rebalancing away from a wedged instance, KProcessor.java:59-60."""
+    msgs = harness_stream(400, seed=43, num_symbols=4, num_accounts=8,
+                          payout_opcode_bug=False, validate=True)
+    per_msg = []
+    ora = OracleEngine("fixed", book_slots=64, max_fills=32)
+    for m in msgs:
+        per_msg.append([r.wire() for r in ora.process(m.copy())])
+
+    ck = str(tmp_path / "root")
+    os.makedirs(ck)
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # stall exactly once, after ~150 messages (past >= 1 checkpoint)
+    env["KME_TEST_STALL_ONCE"] = str(tmp_path / "stalled.flag")
+    env["KME_TEST_STALL_AT"] = "150"
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "kme_tpu.bridge.supervise",
+         "--checkpoint-dir", ck,
+         # the heartbeat stays FRESH during the stall: only the tick
+         # branch may fire (stale-after is set far beyond the test)
+         "--stale-after", "120", "--stall-after", "4",
+         "--max-restarts", "3", "--grace", "30", "--",
+         "--listen", f"127.0.0.1:{port}", "--auto-provision",
+         "--engine", "oracle", "--batch", "20",
+         "--checkpoint-every", "60", "--symbols", "8", "--accounts", "16",
+         "--slots", "64", "--max-fills", "32",
+         "--idle-exit", "6", "--health-every", "0.2"],
+        env=env, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        broker = _wait_broker(port)
+        for m in msgs:
+            broker.produce(TOPIC_IN, None, dumps_order(m))
+        serr = ""
+        try:
+            _, serr = sup.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            sup.kill()
+            _, serr = sup.communicate()
+            pytest.fail(f"supervisor did not finish\n{serr[-3000:]}")
+        assert sup.returncode == 0, serr[-3000:]
+        assert "serve loop stalled" in serr, serr[-3000:]
+        assert "restart 1/" in serr
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+
+    b = InProcessBroker(persist_dir=os.path.join(ck, "broker-log"))
+    got = list(consume_lines(b, follow=False))
+    n = len(msgs)
+    okshape = False
+    for S in range(0, n + 1):
+        tail = [ln for lines in per_msg[S:] for ln in lines]
+        if len(got) < len(tail) or got[len(got) - len(tail):] != tail:
+            continue
+        head = got[:len(got) - len(tail)]
+        want_prefix = [ln for lines in per_msg for ln in lines]
+        if head == want_prefix[:len(head)]:
+            okshape = True
+            break
+    assert okshape, "stream is not the at-least-once prefix+replay shape"
