@@ -30,6 +30,15 @@ Design notes (TPU-first):
   host wrapper so state stays device-resident across batches.
 - All arithmetic is int32/int64 with Java wrap semantics (hardware
   two's-complement — no float in the engine path).
+
+
+ROLE (round 5): this engine is NOT a serving path. Java-mode serving
+runs on the seq kernel (engine/seq.py compat='java', ~100x faster) or
+the native C++ engine; this replica's remaining job is CROSS-EVIDENCE —
+a third, structurally independent implementation of the quirk-exact
+semantics that the test suite pins against the oracle, so a bug in the
+seq kernel's java mode and a matching bug in the oracle cannot hide
+each other.
 """
 
 from __future__ import annotations

@@ -449,12 +449,9 @@ class SeqSession:
         Returns (cols, host_rejects, host dict, fills (4, F))."""
         import time
 
-        from kme_tpu.utils import async_prefetch, pow2_bucket
-
         t0 = time.perf_counter()
         cols, host_rejects, stacked, cnts, K = self._plan(msgs)
         self.phases = {"plan_s": time.perf_counter() - t0}
-        HR = SQ.hdr_rows(self.cfg)
         t0 = time.perf_counter()
         self.state, outp = SQ.build_seq_scan(self.cfg, K)(
             self.state, stacked)
@@ -462,9 +459,18 @@ class SeqSession:
         _jax.block_until_ready(self.state)
         self.phases["dispatch_s"] = time.perf_counter() - t0
         t0 = time.perf_counter()
-        # ONE fetch round in the common case: headers + the adaptive
-        # fill-group hint's worth of fill rows per call; calls whose
-        # fill_total overflows the hint get a (rare) second-round slice
+        host, fills = self._fetch_outputs(outp, cnts, K)
+        self.phases["fetch_s"] = time.perf_counter() - t0
+        return cols, host_rejects, host, fills
+
+    def _fetch_outputs(self, outp, cnts, K):
+        """Fetch + unpack one dispatch's output planes: ONE fetch round
+        in the common case (headers + the adaptive fill-group hint's
+        worth of fill rows per call; calls whose fill_total overflows
+        the hint get a rare second-round slice)."""
+        from kme_tpu.utils import async_prefetch, pow2_bucket
+
+        HR = SQ.hdr_rows(self.cfg)
         ghint = min(pow2_bucket(self._ghint, lo=1),
                     self.cfg.fill_cap // 128)
         fdev = outp[:, :HR + 5 * ghint, :]
@@ -500,12 +506,42 @@ class SeqSession:
             for k in host:
                 host[k].append(res[k])
         self._metrics += mets
-        self.phases["fetch_s"] = time.perf_counter() - t0
         host = {k: np.concatenate(v) if v else np.zeros(0)
                 for k, v in host.items()}
         fills = (np.concatenate(fills, axis=1) if fills
                  else np.zeros((4, 0), np.int64))
-        return cols, host_rejects, host, fills
+        return host, fills
+
+    # -- pipelined serving (H5): dispatch batch N+1 before fetching N --
+
+    def submit(self, msgs):
+        """Route + pack + DISPATCH a micro-batch without fetching its
+        outputs; returns an opaque handle for collect(). Multiple
+        handles may be in flight — state threads through dispatch
+        order, so collect order must match submit order. This is the
+        double-buffered serving shape (SURVEY.md §7 H5): the device
+        executes batch N+1 while the host fetches and reconstructs
+        batch N."""
+        if not isinstance(msgs, WireBatch):
+            try:
+                msgs = WireBatch.from_msgs(msgs)
+            except OverflowError:
+                raise ValueError(
+                    "pipelined serving requires int64-range ids — "
+                    "route beyond-int64 streams through process_wire")
+        cols, host_rejects, stacked, cnts, K = self._plan(msgs)
+        self.state, outp = SQ.build_seq_scan(self.cfg, K)(
+            self.state, stacked)
+        return (msgs, cols, host_rejects, outp, cnts, K)
+
+    def collect(self, handle):
+        """Complete a submit(): fetch + reconstruct the byte stream.
+        Returns (buf, line_off, msg_lines) like process_wire_buffer
+        (requires the native reconstructor and a WireBatch handle)."""
+        batch, cols, host_rejects, outp, cnts, K = handle
+        host, fills = self._fetch_outputs(outp, cnts, K)
+        return self._recon_buffer(batch, cols, host_rejects, host,
+                                  fills)
 
     # ------------------------------------------------------------------
 
@@ -539,6 +575,23 @@ class SeqSession:
 
         cols, host_rejects, host, fills = self._run(batch)
         t0 = time.perf_counter()
+        r = self._recon_buffer(batch, cols, host_rejects, host, fills)
+        self.phases["recon_s"] = time.perf_counter() - t0
+        return r
+
+    def _recon_buffer(self, batch, cols, host_rejects, host, fills):
+        """Columnar inputs + device results -> the byte-exact record
+        stream via the native C++ reconstructor (kme_wire.cpp)."""
+        import ctypes
+
+        from kme_tpu.native import load_library
+
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError(
+                "the native reconstructor (kme_wire.cpp) is required "
+                "for the pipelined/buffer serving path — use "
+                "process_wire on hosts without the native toolchain")
         nmsg = batch.n
         m_action, m_oid, m_aid = batch.action, batch.oid, batch.aid
         m_sid, m_price, m_size = batch.sid, batch.price, batch.size
@@ -611,7 +664,6 @@ class SeqSession:
         line_off[nlines] = blen
         msg_lines = np.ctypeslib.as_array(
             lib.kme_recon_msg_lines(self._recon), (nmsg,)).copy()
-        self.phases["recon_s"] = time.perf_counter() - t0
         return buf, line_off, msg_lines
 
     def process_wire(self, msgs) -> List[List[str]]:

@@ -330,3 +330,30 @@ def test_native_router_matches_python():
     assert nat.aid_idx == py.aid_idx
     assert nat.sid_lane == py.sid_lane
     assert nat.oid_sid == py.oid_sid
+
+
+def test_submit_collect_pipelined_byte_exact(cpu_devices):
+    """The double-buffered serving API (SURVEY.md §7 H5): submit batch
+    N+1 before collecting batch N; the concatenated byte stream equals
+    the one-shot process_wire_buffer output exactly (incl. barriers)."""
+    from kme_tpu.wire import WireBatch
+    from kme_tpu.workload import zipf_symbol_stream
+
+    msgs = zipf_symbol_stream(1500, num_symbols=8, num_accounts=32,
+                              seed=8, zipf_a=1.1, payout_per_mille=4)
+    cfg = SQ.SeqConfig(lanes=8, slots=128, accounts=128, max_fills=16,
+                       batch=256, pos_cap=1 << 12, probe_max=8)
+    a, b = SeqSession(cfg), SeqSession(cfg)
+    ra = a.process_wire_buffer(msgs)
+    if ra is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    parts, pend = [], []
+    for lo in range(0, len(msgs), 256):
+        pend.append(b.submit(WireBatch.from_msgs(msgs[lo:lo + 256])))
+        if len(pend) > 1:
+            parts.append(b.collect(pend.pop(0)))
+    while pend:
+        parts.append(b.collect(pend.pop(0)))
+    assert b"".join(p[0] for p in parts) == ra[0]
