@@ -826,7 +826,17 @@ def main(argv=None) -> int:
     # (their reason to exist); the lanes/seq headline is fixed-mode
     # unless java is explicitly requested
     p.add_argument("--compat", choices=("java", "fixed"), default=None)
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write a Chrome trace-event JSON (chrome://"
+                        "tracing / Perfetto) of the session phase "
+                        "timeline here at exit")
     args = p.parse_args(argv)
+    tracer = None
+    if args.trace_out is not None:
+        from kme_tpu.telemetry import TraceRecorder, install
+
+        tracer = TraceRecorder()
+        install(tracer)   # session PhaseTimers pick it up process-wide
     if args.suite == "lanes" and args.engine == "seq":
         rec = bench_seq_engine(args.events or 100_000, args.symbols,
                                args.accounts, args.seed, args.zipf,
@@ -857,6 +867,10 @@ def main(argv=None) -> int:
     else:
         rec = bench_parity_engine(args.events or 4096, args.seed,
                                   args.batch, args.compat or "java")
+    if tracer is not None:
+        tracer.save(args.trace_out)
+        print(f"kme-bench: trace written to {args.trace_out}",
+              file=sys.stderr)
     out = {k: rec[k] for k in ("metric", "value", "unit", "vs_baseline")}
     print(json.dumps(out))
     print(json.dumps(rec["detail"]), file=sys.stderr)

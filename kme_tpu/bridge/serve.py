@@ -64,6 +64,15 @@ def main(argv=None) -> int:
                         "here (atomic replace) every --health-every "
                         "seconds; kme-supervise watches its mtime")
     p.add_argument("--health-every", type=float, default=1.0)
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve Prometheus text exposition on "
+                        "http://0.0.0.0:PORT/metrics (and JSON on "
+                        "/metrics.json) while the service runs; 0 picks "
+                        "a free port (printed to stderr)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write a Chrome trace-event JSON (chrome://"
+                        "tracing / Perfetto) of the engine phase "
+                        "timeline here at exit")
     args = p.parse_args(argv)
 
     import os
@@ -91,6 +100,12 @@ def main(argv=None) -> int:
               file=sys.stderr)
     if args.auto_provision:
         provision(broker)
+    tracer = None
+    if args.trace_out is not None:
+        from kme_tpu.telemetry import TraceRecorder, install
+
+        tracer = TraceRecorder()
+        install(tracer)   # PhaseTimers pick it up process-wide
     svc = MatchService(broker, engine=args.engine, compat=args.compat,
                        batch=args.batch, symbols=args.symbols,
                        accounts=args.accounts, slots=args.slots,
@@ -98,6 +113,14 @@ def main(argv=None) -> int:
                        shards=args.shards, strict=args.strict,
                        checkpoint_dir=args.checkpoint_dir,
                        checkpoint_every=args.checkpoint_every)
+    msrv = None
+    if args.metrics_port is not None:
+        from kme_tpu.telemetry import start_metrics_server
+
+        msrv = start_metrics_server(svc.telemetry, args.metrics_port)
+        print(f"kme-serve: metrics on "
+              f"http://{msrv.server_address[0]}:"
+              f"{msrv.server_address[1]}/metrics", file=sys.stderr)
     try:
         seen = svc.run(max_messages=args.max_messages,
                        idle_exit=args.idle_exit,
@@ -114,6 +137,12 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if msrv is not None:
+            msrv.shutdown()
+        if tracer is not None:
+            tracer.save(args.trace_out)
+            print(f"kme-serve: trace written to {args.trace_out}",
+                  file=sys.stderr)
         if srv is not None:
             srv.shutdown()
         if hasattr(broker, "close"):

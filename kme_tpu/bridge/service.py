@@ -64,10 +64,12 @@ class MatchService:
         self._last_ckpt_offset = 0
         self._req_symbols, self._req_accounts = symbols, accounts
         self._req_slots, self._req_max_fills = slots, max_fills
+        self._last_engine_pub = 0.0
         resumed = False
         if checkpoint_dir is not None:
             resumed = self._try_resume(engine, compat, shards, width)
         if resumed:
+            self._init_telemetry()
             return
         if engine == "lanes":
             from kme_tpu.engine.lanes import LaneConfig
@@ -94,6 +96,17 @@ class MatchService:
             self._oracle = OracleEngine(compat, **kw)
         else:
             raise ValueError(f"unknown engine {engine!r}")
+        self._init_telemetry()
+
+    def _init_telemetry(self) -> None:
+        """The service's metrics surface (/metrics, heartbeat). Session
+        engines already own a Registry — share it so engine counters,
+        histograms and service counters expose through ONE endpoint;
+        host-only engines (native/oracle) get a service-local one."""
+        from kme_tpu.telemetry import Registry
+
+        self.telemetry = (self._session.telemetry
+                          if self._session is not None else Registry())
 
     # ------------------------------------------------------------------
     # durability: snapshot at batch boundaries, resume = load + replay
@@ -319,7 +332,26 @@ class MatchService:
         # outputs for the whole batch are on MatchOut
         self.offset = recs[-1].offset + 1
         self._maybe_checkpoint()
+        self._publish_batch(len(recs), len(recs) - len(msgs))
         return len(recs)
+
+    def _publish_batch(self, nrecs: int, ndropped: int) -> None:
+        """Per-batch service counters + a rate-limited engine refresh.
+        Runs on the POLL THREAD only: the engine refresh touches device
+        arrays, which the heartbeat/HTTP threads must never do — they
+        read registry snapshots."""
+        import time
+
+        t = self.telemetry
+        t.counter("service_batches").inc()
+        t.counter("service_records").inc(nrecs)
+        t.counter("service_dropped").inc(ndropped)
+        t.gauge("service_offset").set(self.offset)
+        now = time.monotonic()
+        if self._session is not None and now - self._last_engine_pub >= 1.0:
+            self._last_engine_pub = now
+            self._session.metrics()      # publishes counters + gauges
+            self._session.histograms()   # publishes bucket counts
 
     def _produce_lines(self, out) -> None:
         for lines in out:
@@ -449,7 +481,12 @@ class MatchService:
 
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
+            # "metrics" is ADDITIVE — the supervisor keys
+            # (pid/time/seen/offset/tick) are load-bearing
+            # (tests/test_supervise.py). snapshot() only takes the
+            # registry lock; safe from this background thread.
             json.dump({"pid": os.getpid(), "time": _t.time(),
                        "seen": seen, "offset": self.offset,
-                       "tick": tick}, f)
+                       "tick": tick,
+                       "metrics": self.telemetry.snapshot()}, f)
         os.replace(tmp, path)

@@ -96,6 +96,29 @@ METRIC_NAMES = ("msgs", "trades_ok", "fills", "contracts", "rej_capacity",
                 "rej_risk", "rested", "cancels_ok", "rej_cancel",
                 "transfers_ok", "rej_other", "barriers")
 
+# on-device distribution histograms (state["hist"]): power-of-two
+# buckets accumulated next to the metrics counters and fetched in the
+# same device transfer (no extra round-trips). Bucket index for value
+# v is #{k in 0..14 : v >= 2^k}: v <= 0 -> bucket 0, v == 1 -> 1,
+# v in [2^(i-1), 2^i) -> i, v >= 2^14 -> 15.
+HIST_FILLS = 0        # makers swept per ACCEPTED trade (0 = pure rest)
+HIST_DEPTH = 1        # resting orders (both sides) in the touched book
+#                       after each accepted trade/cancel
+HIST_OCCUPANCY = 2    # non-NOP messages per dispatch unit (scan step /
+#                       seq kernel call); empty units are unobserved
+N_HIST = 3
+N_HIST_BUCKETS = 16
+
+HIST_NAMES = ("fills_per_order", "book_depth", "batch_occupancy")
+
+_HIST_THRESH = tuple(1 << k for k in range(N_HIST_BUCKETS - 1))
+
+
+def hist_bucket(v):
+    """Power-of-two bucket index (vectorized, any int shape)."""
+    thr = jnp.asarray(_HIST_THRESH, _I32)
+    return jnp.sum(v[..., None] >= thr, axis=-1).astype(_I32)
+
 
 @dataclasses.dataclass(frozen=True)
 class LaneConfig:
@@ -188,6 +211,13 @@ def make_lane_state(cfg: LaneConfig):
         # free. Snapshots canonicalize to the (12,) array either way.
         "metrics": (tuple(jnp.zeros((), _I64) for _ in range(N_METRICS))
                     if cfg.width > 0 else jnp.zeros((N_METRICS,), _I64)),
+        # distribution histograms (HIST_NAMES rows): same tuple-vs-array
+        # split as the counters; rows stay replicated under sharding
+        # (psum-merged deltas), canonicalized to (N_HIST, B) in snapshots
+        "hist": (tuple(jnp.zeros((N_HIST_BUCKETS,), _I64)
+                       for _ in range(N_HIST))
+                 if cfg.width > 0
+                 else jnp.zeros((N_HIST, N_HIST_BUCKETS), _I64)),
         # persistent fill log: rows oid/aid/price/size; filloff = next
         # free position. Only the used prefix ever crosses to the host
         # (ONE sliced fetch per batch — the tunneled-TPU I/O design, see
@@ -613,6 +643,34 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
                 met = jax.lax.psum(met, axis_name)
             metrics = st["metrics"] + met
 
+        # ---------------------------------------------- histogram deltas
+        # one-hot scatter-adds into the power-of-two bucket rows. Depth
+        # observes the touched book AFTER the message (final slot_used,
+        # cancel clear included); padding/scrap rows carry act=NOP so
+        # trade_acc/cancel_ok exclude them by construction.
+        obs_depth = trade_acc | cancel_ok
+        depth = jnp.sum(slot_used.reshape(X, 2 * N).astype(_I32), axis=1)
+        d_fills = (jnp.zeros((N_HIST_BUCKETS,), _I64)
+                   .at[hist_bucket(nfill)].add(trade_acc.astype(_I64)))
+        d_depth = (jnp.zeros((N_HIST_BUCKETS,), _I64)
+                   .at[hist_bucket(depth)].add(obs_depth.astype(_I64)))
+        occ = jnp.sum((act != L_NOP).astype(_I32))
+        if axis_name is not None:
+            # shard-invariance: merge the per-shard fills/depth deltas;
+            # occupancy counts the GLOBAL step population, so psum the
+            # count BEFORE bucketing — the resulting row is identical
+            # on every shard and needs no merge of its own
+            d_fills = jax.lax.psum(d_fills, axis_name)
+            d_depth = jax.lax.psum(d_depth, axis_name)
+            occ = jax.lax.psum(occ, axis_name)
+        d_occ = (jnp.zeros((N_HIST_BUCKETS,), _I64)
+                 .at[hist_bucket(occ)].add((occ > 0).astype(_I64)))
+        if compact:
+            hist = tuple(h + d for h, d in
+                         zip(st["hist"], (d_fills, d_depth, d_occ)))
+        else:
+            hist = st["hist"] + jnp.stack((d_fills, d_depth, d_occ))
+
         ok = jnp.where(
             is_trade, trade_acc,
             jnp.where(is_cancel, cancel_ok,
@@ -647,14 +705,14 @@ def build_lane_step(cfg: LaneConfig, axis_name: Optional[str] = None):
                 new_st["pos_amt"] = pa_f
                 new_st["pos_avail"] = pv_f
             new_st.update(bal=bal, bal_used=bal_used, err=err,
-                          metrics=metrics)
+                          metrics=metrics, hist=hist)
         else:
             new_st = {
                 **new_rows,
                 "seq": seq, "book_exists": book_exists,
                 "pos_amt": pa_f, "pos_avail": pv_f,
                 "bal": bal, "bal_used": bal_used, "err": err,
-                "metrics": metrics,
+                "metrics": metrics, "hist": hist,
                 "fillbuf": st["fillbuf"], "filloff": st["filloff"],
             }
         outs = {

@@ -71,7 +71,17 @@ from kme_tpu.engine.lanes import (  # noqa: F401 (re-exported act codes)
     MET_MSGS, MET_TRADES_OK, MET_FILLS, MET_CONTRACTS, MET_REJ_CAPACITY,
     MET_REJ_RISK, MET_RESTED, MET_CANCELS_OK, MET_REJ_CANCEL,
     MET_TRANSFERS_OK, MET_REJ_OTHER, MET_BARRIERS,
+    HIST_NAMES, HIST_FILLS, HIST_DEPTH, HIST_OCCUPANCY,
+    N_HIST, N_HIST_BUCKETS,
 )
+
+# scalar-row histogram window: lanes [HIST_LANE0, HIST_LANE0 + 3*16) of
+# output row 0 carry the PER-CALL power-of-two histogram deltas (fills,
+# depth, occupancy — HIST_NAMES order). They are accumulated in a VMEM
+# scratch row already pre-offset to these lanes, so the epilogue merge
+# is one masked where (no lane rotate). Lanes 2..13 hold the 12 metric
+# deltas, so the window starts right after them.
+HIST_LANE0 = 2 + N_METRICS
 
 # barrier acts (device-executed, unlike the lanes engine where barriers
 # are separate settle calls): mode mapping matches barrier_ops.settle
@@ -93,7 +103,7 @@ LN = 128
 
 _STATE_KEYS = ("bo_lo", "bo_hi", "ba", "bp", "bs", "bq",
                "seqc", "bex", "bal_lo", "bal_hi", "bal_u",
-               "hk", "ha_lo", "ha_hi", "hv_lo", "hv_hi", "err")
+               "hk", "ha_lo", "ha_hi", "hv_lo", "hv_hi", "dep", "err")
 
 # java mode: Q11 positions are keyed by 128-bit pairs — real keys
 # (aid, sid), garbage keys (amount, available) — with true deletion
@@ -202,6 +212,10 @@ def make_seq_state(cfg: SeqConfig):
             "hk": z(cfg.caprows),
             "ha_lo": z(cfg.caprows), "ha_hi": z(cfg.caprows),
             "hv_lo": z(cfg.caprows), "hv_hi": z(cfg.caprows),
+            # per-lane occupied-slot count (both sides), maintained
+            # incrementally for the book-depth histogram: a both-plane
+            # reduction per message would dwarf the message cost
+            "dep": z(cfg.srows),
         })
     return common
 
@@ -376,6 +390,23 @@ def build_seq_step(cfg: SeqConfig):
             r0 = st["err"][0:1, :]
             st["err"][0:1, :] = jnp.where(
                 (ci == _i(0)) & (r0 == _i(LERR_OK)), code, r0)
+
+        def hbucket(v):
+            """power-of-two bucket index of scalar v (lanes.hist_bucket
+            semantics): #{k in 0..14 : v >= 2^k}."""
+            b = _i(0)
+            for k2 in range(N_HIST_BUCKETS - 1):
+                b = b + (v >= _i(1 << k2)).astype(I32)
+            return b
+
+        def hist_obs(cond, lane0, v):
+            """bump the scratch histogram row (pre-offset scalar-row
+            lanes) at bucket(v) of the histogram starting at lane0."""
+            @pl.when(cond)
+            def _():
+                hr = vr[NR + 2:NR + 3, :]
+                vr[NR + 2:NR + 3, :] = hr + (
+                    ci == _i(lane0) + hbucket(v)).astype(I32)
 
         # -------- balances (row r = acc >> 7, lane l = acc & 127)
         def bal_get(acc):
@@ -907,7 +938,8 @@ def build_seq_step(cfg: SeqConfig):
             # ---------------- cross-section scalar defaults -----------
             # sm: 0 trade_ok, 1 trade_acc, 2 cap_reject, 3 append,
             #     4 residual echo, 5 nfill, 6/7 tail prev lo/hi,
-            #     8 do_rest, 9 cancel_ok. The heavy sections below run
+            #     8 do_rest, 9 cancel_ok, 10 emptied-maker count (dep
+            #     plane decrement). The heavy sections below run
             #     under pl.when(act) branches (a NOP/CREATE message
             #     must not pay for hash probes or book reductions) and
             #     publish their scalar results here for the epilogue.
@@ -921,6 +953,7 @@ def build_seq_step(cfg: SeqConfig):
             sm[7] = _i(0)
             sm[8] = _i(0)
             sm[9] = _i(0)
+            sm[10] = _i(0)
 
             # ================ TRADE section (pl.when-gated) ===========
             @pl.when(is_trade)
@@ -978,7 +1011,7 @@ def build_seq_step(cfg: SeqConfig):
                     # ref load or a recomputed iota — closure-captured
                     # vector VALUES become per-iteration loop inputs in
                     # Mosaic and cost ~2us/iteration (measured)
-                    remaining, e, ovf, emptied, done = c
+                    remaining, e, ovf, emptied, nempt, done = c
                     fi2 = (jax.lax.broadcasted_iota(I32, (NR, LN), 0)
                            * _i(LN)
                            + jax.lax.broadcasted_iota(I32, (NR, LN), 1))
@@ -1016,12 +1049,15 @@ def build_seq_step(cfg: SeqConfig):
                     # (the Q2 ghost-trade precondition: the reference loop
                     # re-evaluates its guard only after a maker empties)
                     emptied = jnp.where(take, have - fill == _i(0), emptied)
+                    # emptied-maker COUNT: the dep plane's trade decrement
+                    nempt = nempt + (take
+                                     & (have - fill == _i(0))).astype(I32)
                     done = (~anyc) | exceed | (remaining == _i(0))
-                    return remaining, e, ovf, emptied, done
+                    return remaining, e, ovf, emptied, nempt, done
 
-                (residual_t, nfill, ovf_fills, last_emptied, _d) = \
-                    jax.lax.while_loop(lambda c: ~c[4], sweep,
-                                       (want, _i(0), False, False,
+                (residual_t, nfill, ovf_fills, last_emptied, nempt, _d) = \
+                    jax.lax.while_loop(lambda c: ~c[5], sweep,
+                                       (want, _i(0), False, False, _i(0),
                                         want == _i(0)))
                 wsize = vr[0:NR, :]
                 if JAVA:
@@ -1211,6 +1247,7 @@ def build_seq_step(cfg: SeqConfig):
                 sm[6] = tail_lo
                 sm[7] = tail_hi
                 sm[8] = do_rest.astype(I32)
+                sm[10] = jnp.where(trade_acc, nempt, _i(0))
 
             # ---------------- CANCEL ----------------------------------
             # (pl.when-gated: only cancels pay for the
@@ -1400,6 +1437,24 @@ def build_seq_step(cfg: SeqConfig):
             resid_v = sm[4]
             nf = sm[5]
             c_ok = sm[9] != _i(0)
+
+            # ------------- dep plane + distribution histograms --------
+            # fills-per-order: one observation per ACCEPTED trade
+            hist_obs(t_acc, HIST_LANE0, nf)
+            if not JAVA:
+                # per-lane occupied-slot count: +rested -emptied on an
+                # accepted trade, -1 on a cancel, wiped by a barrier;
+                # the post-message value feeds the book-depth histogram
+                @pl.when(t_acc | c_ok | barrier_do)
+                def _():
+                    dval = rget(st["dep"], lr, ll)
+                    newd = jnp.where(
+                        barrier_do, _i(0),
+                        dval + sm[8] - sm[10] - c_ok.astype(I32))
+                    put(st["dep"], lr, ll, newd)
+                    hist_obs(t_acc | c_ok,
+                             HIST_LANE0 + N_HIST_BUCKETS, newd)
+
             ok = jnp.where(
                 is_trade, t_acc,
                 jnp.where(is_cancel, c_ok,
@@ -1442,6 +1497,9 @@ def build_seq_step(cfg: SeqConfig):
             fill_total2 = fill_total + nf
             return (fill_total2, cur_lane, met)
 
+        # per-call histogram deltas accumulate in the scratch row,
+        # pre-offset to their final scalar-row lanes
+        vr[NR + 2:NR + 3, :] = jnp.zeros((1, LN), I32)
         met0 = tuple(_i(0) for _ in range(N_METRICS))
         fill_total, cur_lane, met = _fori32(
             B, one, (_i(0), _i(-1), met0))
@@ -1450,12 +1508,22 @@ def build_seq_step(cfg: SeqConfig):
             def _():
                 books_flush(cur_lane)
 
-        # scalar row: lane0 err, lane1 fill_total, lanes 2.. metrics
+        # batch occupancy: ONE observation per non-empty kernel call
+        # (met[0] = this call's non-NOP message count)
+        hist_obs(met[0] > _i(0), HIST_LANE0 + 2 * N_HIST_BUCKETS, met[0])
+
+        # scalar row: lane0 err, lane1 fill_total, lanes 2.. metrics,
+        # lanes HIST_LANE0.. the histogram deltas (already in place in
+        # the scratch row)
         errv = pick(st["err"][0:1, :], _i(0))
         scal = jnp.where(ci == _i(0), errv, _i(0))
         scal = jnp.where(ci == _i(1), fill_total, scal)
         for k in range(N_METRICS):
             scal = jnp.where(ci == _i(2 + k), met[k], scal)
+        hr = vr[NR + 2:NR + 3, :]
+        scal = jnp.where(
+            (ci >= _i(HIST_LANE0))
+            & (ci < _i(HIST_LANE0 + N_HIST * N_HIST_BUCKETS)), hr, scal)
         out[0:1, :] = scal
 
     nstate = len(KEYS)
@@ -1469,7 +1537,7 @@ def build_seq_step(cfg: SeqConfig):
         return pl.BlockSpec(memory_space=pltpu.VMEM)
 
     scratches = [pltpu.SMEM((16,), I32),
-                 pltpu.VMEM((NR + 2, LN), I32)] \
+                 pltpu.VMEM((NR + 3, LN), I32)] \
         + ([pltpu.VMEM((2 * NR, LN), I32)] * 6
            + [pltpu.SemaphoreType.DMA((6,))] if cfg.hbm_books else [])
 
@@ -1574,6 +1642,8 @@ def unpack_hdr(cfg: SeqConfig, hdr: np.ndarray, n: int) -> dict:
         "err": int(scal[0]),
         "fill_total": int(scal[1]),
         "metrics": scal[2:2 + N_METRICS].astype(np.int64),
+        "hist": scal[HIST_LANE0:HIST_LANE0 + N_HIST * N_HIST_BUCKETS]
+        .astype(np.int64).reshape(N_HIST, N_HIST_BUCKETS),
     }
     return res
 
@@ -1824,6 +1894,11 @@ def import_canonical(cfg: SeqConfig, canon: dict):
         "ha_hi": jnp.asarray(hahi.reshape(capr, LN)),
         "hv_lo": jnp.asarray(hvlo.reshape(capr, LN)),
         "hv_hi": jnp.asarray(hvhi.reshape(capr, LN)),
+        # dep is derived state (occupied slots per lane, both sides) —
+        # recomputed here so canonical snapshots stay engine-agnostic
+        "dep": jnp.asarray(padplane(
+            (sizes.reshape(S, -1) > 0).sum(axis=1).astype(np.int32),
+            cfg.srows)),
         "err": jnp.asarray(padplane(
             np.array([int(canon.get("err", 0))], np.int32), 1)),
     }

@@ -71,7 +71,8 @@ def state_specs(state) -> dict:
     on the symbol axis, account/global arrays replicated."""
     specs = {}
     for k, v in state.items():
-        if k in ("bal", "bal_used", "err", "metrics", "fillbuf", "filloff"):
+        if k in ("bal", "bal_used", "err", "metrics", "hist", "fillbuf",
+                 "filloff"):
             # the packed fill log is REPLICATED: the chunk wrapper runs
             # under GSPMD, which gathers each window's compact (M, E)
             # fills over the mesh before the append — so every shard

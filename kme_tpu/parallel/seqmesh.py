@@ -48,6 +48,7 @@ from jax.sharding import PartitionSpec as P
 from kme_tpu.engine import seq as SQ
 from kme_tpu.parallel.mesh import AXIS, build_mesh
 from kme_tpu.runtime.seqsession import SeqSession, make_seq_router
+from kme_tpu.telemetry import PhaseTimer, Registry
 from kme_tpu.utils import pow2_bucket
 
 # per-shard per-window message capacity (windows close earlier on
@@ -101,8 +102,13 @@ def _shard_map(fn, mesh, in_specs, out_specs):
     try:
         return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                   check_vma=False)
-    except TypeError:  # pragma: no cover - jax without check_vma
-        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    except TypeError:  # older jax spells the flag check_rep
+        try:
+            return sm(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+        except TypeError:  # pragma: no cover - jax without either flag
+            return sm(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs)
 
 
 @functools.lru_cache(maxsize=None)
@@ -175,8 +181,11 @@ class SeqMeshSession(SeqSession):
         self.state = make_mesh_state(self.local_cfg, shards)
         self.router = make_seq_router(cfg.lanes, cfg.accounts)
         self._metrics = np.zeros(SQ.N_METRICS, np.int64)
+        self._hist = np.zeros((SQ.N_HIST, SQ.N_HIST_BUCKETS), np.int64)
         self._recon = None
-        self.phases = {}
+        self.telemetry = Registry()
+        self.timer = PhaseTimer(track="seqmesh")
+        self.phases = self.timer.totals   # cumulative across batches
         self._use_native_wire = True
         self._ghint = 8
 
@@ -267,61 +276,62 @@ class SeqMeshSession(SeqSession):
     # -- the SeqSession contract ---------------------------------------
 
     def _run(self, msgs):
-        import time
-
         from kme_tpu.runtime.session import LaneEngineError
 
-        t0 = time.perf_counter()
-        cols, host_rejects = self.router.route(msgs)
-        wins, placements, cnts, K = self.plan_windows(cols)
-        self.phases = {"plan_s": time.perf_counter() - t0}
+        with self.timer.phase("plan_s"):
+            cols, host_rejects = self.router.route(msgs)
+            wins, placements, cnts, K = self.plan_windows(cols)
 
-        t0 = time.perf_counter()
-        scan = build_seq_mesh_scan(self.local_cfg, self.shards, K)
-        self.state, outs = scan(self.state, wins)
-        jax.block_until_ready(self.state)
-        self.phases["dispatch_s"] = time.perf_counter() - t0
+        with self.timer.phase("dispatch_s"):
+            scan = build_seq_mesh_scan(self.local_cfg, self.shards, K)
+            self.state, outs = scan(self.state, wins)
+            jax.block_until_ready(self.state)
 
-        t0 = time.perf_counter()
-        outs = np.asarray(outs)   # (K, shards, NROWS, 128)
-        HR = SQ.hdr_rows(self.local_cfg)
-        n = len(cols["act"])
-        host = {k: np.zeros(n, dt) for k, dt in
-                (("ok", bool), ("cap_reject", bool), ("append", bool),
-                 ("residual", np.int64), ("nfill", np.int64),
-                 ("prev_oid", np.int64))}
-        groups = {}
-        mets = np.zeros(SQ.N_METRICS, np.int64)
-        for w in range(K):
-            for s in range(self.shards):
-                cnt = int(cnts[w, s])
-                if not cnt:
-                    continue
-                res = SQ.unpack_hdr(self.local_cfg, outs[w, s][:HR], cnt)
-                if res["err"] != SQ.LERR_OK:
-                    raise LaneEngineError(res["err"])
-                ft = res["fill_total"]
-                gr = outs[w, s][HR:HR + 5 * (-(-max(ft, 1) // 128))]
-                groups[(w, s)] = (res, SQ.unpack_fills(gr, ft),
-                                  np.concatenate(
-                                      ([0], np.cumsum(res["nfill"]))))
-                mets += res["metrics"]
-        self._metrics += mets
-        fills_parts = []
-        for k, w, s, p in placements:
-            res, fills_ws, off = groups[(w, s)]
-            for key in host:
-                host[key][k] = res[key][p]
-            if res["nfill"][p]:
-                fills_parts.append(fills_ws[:, off[p]:off[p + 1]])
-        fills = (np.concatenate(fills_parts, axis=1) if fills_parts
-                 else np.zeros((4, 0), np.int64))
-        self.phases["fetch_s"] = time.perf_counter() - t0
-        self.phases["recon_s"] = 0.0
+        with self.timer.phase("fetch_s"):
+            outs = np.asarray(outs)   # (K, shards, NROWS, 128)
+            HR = SQ.hdr_rows(self.local_cfg)
+            n = len(cols["act"])
+            host = {k: np.zeros(n, dt) for k, dt in
+                    (("ok", bool), ("cap_reject", bool),
+                     ("append", bool), ("residual", np.int64),
+                     ("nfill", np.int64), ("prev_oid", np.int64))}
+            groups = {}
+            mets = np.zeros(SQ.N_METRICS, np.int64)
+            # per-(window, shard) kernel calls are the dispatch units
+            # here, so batch_occupancy observes per-shard sub-windows
+            hists = np.zeros((SQ.N_HIST, SQ.N_HIST_BUCKETS), np.int64)
+            for w in range(K):
+                for s in range(self.shards):
+                    cnt = int(cnts[w, s])
+                    if not cnt:
+                        continue
+                    res = SQ.unpack_hdr(self.local_cfg,
+                                        outs[w, s][:HR], cnt)
+                    if res["err"] != SQ.LERR_OK:
+                        raise LaneEngineError(res["err"])
+                    ft = res["fill_total"]
+                    gr = outs[w, s][HR:HR + 5 * (-(-max(ft, 1) // 128))]
+                    groups[(w, s)] = (res, SQ.unpack_fills(gr, ft),
+                                      np.concatenate(
+                                          ([0], np.cumsum(res["nfill"]))))
+                    mets += res["metrics"]
+                    hists += res["hist"]
+            self._metrics += mets
+            self._hist += hists
+            fills_parts = []
+            for k, w, s, p in placements:
+                res, fills_ws, off = groups[(w, s)]
+                for key in host:
+                    host[key][k] = res[key][p]
+                if res["nfill"][p]:
+                    fills_parts.append(fills_ws[:, off[p]:off[p + 1]])
+            fills = (np.concatenate(fills_parts, axis=1) if fills_parts
+                     else np.zeros((4, 0), np.int64))
         return cols, host_rejects, host, fills
 
     def metrics(self) -> Dict[str, int]:
         counters = dict(zip(SQ.METRIC_NAMES, self._metrics.tolist()))
+        self._publish(counters)
         return counters
 
     def export_state(self):

@@ -236,6 +236,22 @@ def _restore_one(path: str, shards: Optional[int], width: Optional[int]):
             state[k] = (tuple(jnp.asarray(x) for x in arr)
                         if isinstance(v, tuple) else jnp.asarray(arr))
             continue
+        if k == "hist":
+            if k not in data.files:
+                state[k] = v  # pure observability: pre-histogram
+                continue      # snapshots restore with fresh zeros
+            arr = np.asarray(data[k])
+            want = ((len(v), len(v[0])) if isinstance(v, tuple)
+                    else tuple(v.shape))
+            if arr.shape != want:
+                raise ValueError(
+                    f"snapshot {path}: shape mismatch for hist: "
+                    f"{arr.shape} vs {want}")
+            # compact device state carries one (16,) bucket row per
+            # histogram as a tuple; the canonical form is (3, 16)
+            state[k] = (tuple(jnp.asarray(x) for x in arr)
+                        if isinstance(v, tuple) else jnp.asarray(arr))
+            continue
         arr = np.asarray(data[k])
         if k in _POS_KEYS:
             # canonical form is ALWAYS flat (S*A,) s64; the device
@@ -301,6 +317,7 @@ def save_seq_session(ckpt_dir: str, session, offset: int) -> str:
         "offset": int(offset),
         "cfg": dataclasses.asdict(session.cfg),
         "metrics": [int(x) for x in session._metrics],
+        "hist": [[int(x) for x in row] for row in session._hist],
         "aid_idx": sorted(r.aid_idx.items()),
         "sid_lane": sorted(r.sid_lane.items()),
         "oid_sid": sorted(r.oid_sid.items()),
@@ -334,6 +351,7 @@ def _save_seqjava(ckpt_dir: str, session, offset: int) -> str:
         "offset": int(offset),
         "cfg": dataclasses.asdict(session.cfg),
         "metrics": [int(x) for x in session._metrics],
+        "hist": [[int(x) for x in row] for row in session._hist],
         "aid_idx": sorted(snap["aid_idx"].items()),
         "sid_lane": sorted(snap["sid_lane"].items()),
         "oid_sid": sorted(snap["oid_sid"].items()),
@@ -407,6 +425,8 @@ def _restore_seq_one(path: str, cfg):
             raise SnapshotCapacityError(str(e)) from e
         if "metrics" in meta:
             ses._metrics = np.asarray(meta["metrics"], np.int64)
+        if "hist" in meta:
+            ses._hist = np.asarray(meta["hist"], np.int64)
         return ses
     if cfg is not None and cfg.compat == "java":
         raise SnapshotCapacityError(
@@ -448,6 +468,8 @@ def _restore_seq_one(path: str, cfg):
         raise SnapshotCapacityError(str(e)) from e
     if "metrics" in meta:
         ses._metrics = np.asarray(meta["metrics"], np.int64)
+    if "hist" in meta:
+        ses._hist = np.asarray(meta["hist"], np.int64)
     r = ses.router
     r.aid_idx = {int(k): int(i) for k, i in meta["aid_idx"]}
     r.sid_lane = {int(k): int(l) for k, l in meta["sid_lane"]}

@@ -28,6 +28,7 @@ from kme_tpu.engine import seq as SQ
 from kme_tpu.runtime import session as _session
 from kme_tpu.runtime.session import LaneEngineError
 from kme_tpu.runtime.sequencer import CapacityError, EnvelopeError
+from kme_tpu.telemetry import PhaseTimer, Registry
 from kme_tpu.wire import OrderMsg, OutRecord, WireBatch, order_json
 
 # register the seq-specific sticky-error name so LaneEngineError renders
@@ -394,8 +395,13 @@ class SeqSession:
         self.router = make_seq_router(cfg.lanes, cfg.accounts,
                                       compat=cfg.compat)
         self._metrics = np.zeros(SQ.N_METRICS, np.int64)
+        self._hist = np.zeros((SQ.N_HIST, SQ.N_HIST_BUCKETS), np.int64)
         self._recon = None          # native reconstructor handle
-        self.phases = {}            # wall time per phase of the last run
+        self.telemetry = Registry()
+        self.timer = PhaseTimer(track="seq")
+        # CUMULATIVE wall time per phase across every batch (the timer's
+        # totals dict IS this attribute; snapshot/reset via self.timer)
+        self.phases = self.timer.totals
         self._use_native_wire = True
         # adaptive fill-slice hint (fill groups per call fetched in the
         # single-round fetch; grows to the observed high-water mark)
@@ -445,22 +451,18 @@ class SeqSession:
         """Plan (route + pack) + dispatch (ONE lax.scan jit call over
         all chunks), then fetch in one concurrent round (headers +
         adaptive fill prefix; rare overflow slices in a second round).
-        Phase wall times land in self.phases (the bench reads them).
+        Phase wall times ACCUMULATE in self.phases (the bench and the
+        service read them; reset via self.timer.reset()).
         Returns (cols, host_rejects, host dict, fills (4, F))."""
-        import time
-
-        t0 = time.perf_counter()
-        cols, host_rejects, stacked, cnts, K = self._plan(msgs)
-        self.phases = {"plan_s": time.perf_counter() - t0}
-        t0 = time.perf_counter()
-        self.state, outp = SQ.build_seq_scan(self.cfg, K)(
-            self.state, stacked)
-        import jax as _jax
-        _jax.block_until_ready(self.state)
-        self.phases["dispatch_s"] = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        host, fills = self._fetch_outputs(outp, cnts, K)
-        self.phases["fetch_s"] = time.perf_counter() - t0
+        with self.timer.phase("plan_s"):
+            cols, host_rejects, stacked, cnts, K = self._plan(msgs)
+        with self.timer.phase("dispatch_s"):
+            self.state, outp = SQ.build_seq_scan(self.cfg, K)(
+                self.state, stacked)
+            import jax as _jax
+            _jax.block_until_ready(self.state)
+        with self.timer.phase("fetch_s"):
+            host, fills = self._fetch_outputs(outp, cnts, K)
         return cols, host_rejects, host, fills
 
     def _fetch_outputs(self, outp, cnts, K):
@@ -480,12 +482,14 @@ class SeqSession:
                                 "nfill", "prev_oid")}
         results = []
         mets = np.zeros(SQ.N_METRICS, np.int64)
+        hists = np.zeros((SQ.N_HIST, SQ.N_HIST_BUCKETS), np.int64)
         for ci in range(K):
             res = SQ.unpack_hdr(self.cfg, fetched[ci][:HR], cnts[ci])
             if res["err"] != SQ.LERR_OK:
                 raise LaneEngineError(res["err"])
             results.append(res)
             mets += res["metrics"]
+            hists += res["hist"]
         gneed = [-(-max(r["fill_total"], 1) // 128) for r in results]
         self._ghint = max(self._ghint, *gneed)
         over = [ci for ci in range(K) if gneed[ci] > ghint]
@@ -506,6 +510,7 @@ class SeqSession:
             for k in host:
                 host[k].append(res[k])
         self._metrics += mets
+        self._hist += hists
         host = {k: np.concatenate(v) if v else np.zeros(0)
                 for k, v in host.items()}
         fills = (np.concatenate(fills, axis=1) if fills
@@ -571,12 +576,10 @@ class SeqSession:
                 batch = WireBatch.from_msgs(msgs)
             except OverflowError:
                 return None  # beyond-int64 ids ride the Python path
-        import time
-
         cols, host_rejects, host, fills = self._run(batch)
-        t0 = time.perf_counter()
-        r = self._recon_buffer(batch, cols, host_rejects, host, fills)
-        self.phases["recon_s"] = time.perf_counter() - t0
+        with self.timer.phase("recon_s"):
+            r = self._recon_buffer(batch, cols, host_rejects, host,
+                                   fills)
         return r
 
     def _recon_buffer(self, batch, cols, host_rejects, host, fills):
@@ -816,18 +819,36 @@ class SeqSession:
                 "max_book_depth": int(used.sum(axis=2).max())
                 if used.size else 0,
             })
-            return counters
-        canon = SQ.export_canonical(self.cfg, self.state)
-        used = canon["slot_used"]
-        depth = used.sum(axis=2)
-        counters.update({
-            "open_orders": int(used.sum()),
-            "books": int(canon["book_exists"].sum()),
-            "accounts": int(canon["bal_used"].sum()),
-            "positions": int((canon["pos_amt"] != 0).sum()),
-            "max_book_depth": int(depth.max()) if depth.size else 0,
-        })
+        else:
+            canon = SQ.export_canonical(self.cfg, self.state)
+            used = canon["slot_used"]
+            depth = used.sum(axis=2)
+            counters.update({
+                "open_orders": int(used.sum()),
+                "books": int(canon["book_exists"].sum()),
+                "accounts": int(canon["bal_used"].sum()),
+                "positions": int((canon["pos_amt"] != 0).sum()),
+                "max_book_depth": int(depth.max()) if depth.size else 0,
+            })
+        self._publish(counters)
         return counters
+
+    def histograms(self) -> Dict[str, list]:
+        """Device-accumulated distribution histograms (HIST_NAMES ->
+        16 power-of-two bucket counts); published into the registry.
+        book_depth stays empty in java mode (Q1 merged books have no
+        per-lane occupancy plane)."""
+        h = {name: self._hist[i].tolist()
+             for i, name in enumerate(SQ.HIST_NAMES)}
+        self.telemetry.publish_histograms(h)
+        return h
+
+    def _publish(self, counters: Dict[str, int]) -> None:
+        self.telemetry.publish_counters(
+            {k: counters[k] for k in SQ.METRIC_NAMES})
+        self.telemetry.publish_gauges(
+            {k: v for k, v in counters.items()
+             if k not in SQ.METRIC_NAMES})
 
     def export_state(self) -> Dict[str, dict]:
         """Oracle-comparable host dict view."""

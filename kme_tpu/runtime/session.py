@@ -29,6 +29,7 @@ import jax
 from kme_tpu import opcodes as op
 from kme_tpu.engine import lanes as L
 from kme_tpu.runtime.sequencer import Schedule, make_scheduler
+from kme_tpu.telemetry import PhaseTimer, Registry
 from kme_tpu.wire import OrderMsg, OutRecord
 
 _LERR_NAMES = {
@@ -105,6 +106,10 @@ class LaneSession:
             self._settle = jax.jit(L.build_barrier_ops(self.dev_cfg),
                                    donate_argnums=(0,))
         self.scheduler = make_scheduler(cfg.lanes, cfg.accounts, width=W)
+        self.telemetry = Registry()
+        self.timer = PhaseTimer(track="lanes")
+        # the timer owns the dict: phase totals ACCUMULATE across batches
+        self.phases = self.timer.totals
 
     # ------------------------------------------------------------------
 
@@ -219,21 +224,30 @@ class LaneSession:
     # ------------------------------------------------------------------
 
     def process(self, msgs: Sequence[OrderMsg]) -> List[List[OutRecord]]:
-        sched = self.scheduler.plan(msgs)
-        runs, barrier_ok_dev = self._dispatch(sched)
-        fills = self._fetch(runs)
-        return self._reconstruct(msgs, sched, runs, barrier_ok_dev, fills)
+        with self.timer.phase("plan_s"):
+            sched = self.scheduler.plan(msgs)
+        with self.timer.phase("dispatch_s"):
+            runs, barrier_ok_dev = self._dispatch(sched)
+        with self.timer.phase("fetch_s"):
+            fills = self._fetch(runs)
+        with self.timer.phase("recon_s"):
+            return self._reconstruct(msgs, sched, runs, barrier_ok_dev,
+                                     fills)
 
     def process_wire(self, msgs: Sequence[OrderMsg]) -> List[List[str]]:
         """Like process(), but returns the byte-exact `<key> <json>` wire
         lines (consumer.js:19 format) directly — no per-record Python
         objects. This is the serving/bench path; equivalence with
         process() is pinned by tests/test_lanes_engine.py."""
-        sched = self.scheduler.plan(msgs)
-        runs, barrier_ok_dev = self._dispatch(sched)
-        fills = self._fetch(runs)
-        return self._reconstruct_wire(msgs, sched, runs, barrier_ok_dev,
-                                      fills)
+        with self.timer.phase("plan_s"):
+            sched = self.scheduler.plan(msgs)
+        with self.timer.phase("dispatch_s"):
+            runs, barrier_ok_dev = self._dispatch(sched)
+        with self.timer.phase("fetch_s"):
+            fills = self._fetch(runs)
+        with self.timer.phase("recon_s"):
+            return self._reconstruct_wire(msgs, sched, runs, barrier_ok_dev,
+                                          fills)
 
     def _reconstruct_wire(self, msgs, sched, runs, barrier_ok_dev, fills):
         idx_to_aid = self.scheduler.acct_of_idx()
@@ -393,7 +407,26 @@ class LaneSession:
         counters = dict(zip(L.METRIC_NAMES, np.asarray(m).tolist()))
         gauges = L.build_gauges(self.dev_cfg)(self.state)
         counters.update({k: int(np.asarray(v)) for k, v in gauges.items()})
+        self._publish(counters)
         return counters
+
+    def histograms(self) -> Dict[str, list]:
+        """In-kernel distribution histograms (power-of-two buckets), read
+        back with the same one-transfer discipline as metrics()."""
+        h = self.state["hist"]
+        if isinstance(h, tuple):  # compact-mode per-hist rows
+            h = jax.numpy.stack(h)  # stack on device, ONE transfer
+        rows = np.asarray(h)
+        out = {name: rows[i].tolist() for i, name in enumerate(L.HIST_NAMES)}
+        self.telemetry.publish_histograms(out)
+        return out
+
+    def _publish(self, counters: Dict[str, int]) -> None:
+        self.telemetry.publish_counters(
+            {k: counters[k] for k in L.METRIC_NAMES})
+        self.telemetry.publish_gauges(
+            {k: v for k, v in counters.items()
+             if k not in L.METRIC_NAMES})
 
     def export_state(self) -> Dict[str, dict]:
         """Host dict view comparable to the oracle's stores (fixed mode)."""

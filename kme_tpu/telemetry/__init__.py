@@ -1,0 +1,23 @@
+"""Unified telemetry: metric registry, phase tracing, live HTTP surface.
+
+- registry: Counter/Gauge/Histogram + Prometheus text + JSON export
+- trace: PhaseTimer spans + Chrome trace-event recording
+- httpd: stdlib /metrics endpoint over a Registry
+"""
+
+from kme_tpu.telemetry.registry import (  # noqa: F401
+    BUCKET_LE,
+    N_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    bucket_index,
+)
+from kme_tpu.telemetry.trace import (  # noqa: F401
+    PhaseTimer,
+    TraceRecorder,
+    get_tracer,
+    install,
+)
+from kme_tpu.telemetry.httpd import start_metrics_server  # noqa: F401

@@ -1,0 +1,211 @@
+"""Metric registry: Counter / Gauge / Histogram with Prometheus text
+exposition and JSON export.
+
+One Registry instance is owned by each session (LaneSession,
+SeqSession, SeqMeshSession) and shared with the serving layer —
+`MatchService` publishes its per-batch counters into the same registry
+the engine projects its on-device counters into, so a single
+`/metrics` scrape (telemetry/httpd.py) sees both.
+
+Histograms use the engine's power-of-two bucket layout (16 buckets,
+engine/lanes.py): bucket 0 holds values <= 0, bucket i (1..14) holds
+values in [2^(i-1), 2^i - 1], bucket 15 holds values >= 2^14. The
+Prometheus exposition therefore uses cumulative upper bounds
+le="0","1","3","7",...,"16383","+Inf". Device-filled histograms carry
+no true sum (the kernel only accumulates bucket counts); `sum` is
+exact only for host-side `observe()` use.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+N_BUCKETS = 16
+
+# upper bound of bucket i: 0 for i=0, 2^i - 1 for 1..14, +Inf for 15
+BUCKET_LE = tuple(
+    ["0"] + [str((1 << i) - 1) for i in range(1, N_BUCKETS - 1)] + ["+Inf"])
+
+
+def bucket_index(v: int) -> int:
+    """Host-side mirror of the kernel bucketing: #{k in 0..14 : v >= 2^k}."""
+    b = 0
+    for k in range(N_BUCKETS - 1):
+        if v >= (1 << k):
+            b += 1
+    return b
+
+
+class Counter:
+    """Monotonic counter. Sessions project absolute on-device totals via
+    set(); host-side producers use inc()."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0
+
+    def inc(self, delta: int = 1) -> None:
+        self.value += delta
+
+    def set(self, value: int) -> None:
+        self.value = int(value)
+
+
+class Gauge:
+    """Point-in-time value (may go down)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0
+
+    def set(self, value) -> None:
+        self.value = value
+
+    def inc(self, delta=1) -> None:
+        self.value += delta
+
+
+class Histogram:
+    """Power-of-two bucket histogram (engine layout, N_BUCKETS buckets).
+
+    Two fill modes: host-side observe(v) (tracks an exact sum), or
+    set_buckets(counts) projecting device-accumulated bucket counts
+    (sum stays whatever was last set via set_sum, default 0)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.buckets = [0] * N_BUCKETS
+        self.sum = 0
+
+    def observe(self, value: int) -> None:
+        self.buckets[bucket_index(value)] += 1
+        self.sum += value
+
+    def set_buckets(self, counts) -> None:
+        counts = [int(c) for c in counts]
+        if len(counts) != N_BUCKETS:
+            raise ValueError(
+                f"{self.name}: expected {N_BUCKETS} buckets, "
+                f"got {len(counts)}")
+        self.buckets = counts
+
+    def set_sum(self, value) -> None:
+        self.sum = value
+
+    @property
+    def count(self) -> int:
+        return sum(self.buckets)
+
+
+def _sanitize(name: str) -> str:
+    out = []
+    for i, ch in enumerate(name):
+        ok = ch.isalpha() or ch == "_" or ch == ":" or (ch.isdigit() and i)
+        out.append(ch if ok else "_")
+    return "".join(out)
+
+
+class Registry:
+    """Thread-safe metric registry.
+
+    Writers (the session main thread, MatchService.step) mutate under
+    the lock via counter()/gauge()/histogram() handles; readers (the
+    heartbeat thread, the /metrics HTTP handler) take consistent
+    snapshots via prometheus_text()/to_json()/snapshot()."""
+
+    def __init__(self, namespace: str = ""):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._metrics: dict = {}  # insertion-ordered
+
+    def _get(self, cls, name: str, help: str):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get(Histogram, name, help)
+
+    # -- bulk publication (the session metrics()/histograms() projection)
+
+    def publish_counters(self, counters: dict) -> None:
+        for k, v in counters.items():
+            self.counter(k).set(v)
+
+    def publish_gauges(self, gauges: dict) -> None:
+        for k, v in gauges.items():
+            self.gauge(k).set(v)
+
+    def publish_histograms(self, hists: dict) -> None:
+        for k, buckets in hists.items():
+            self.histogram(k).set_buckets(buckets)
+
+    # -- export
+
+    def _qualified(self, name: str) -> str:
+        base = _sanitize(name)
+        return f"{self.namespace}_{base}" if self.namespace else base
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            items = list(self._metrics.items())
+        lines = []
+        for name, m in items:
+            q = self._qualified(name)
+            if m.help:
+                lines.append(f"# HELP {q} {m.help}")
+            lines.append(f"# TYPE {q} {m.kind}")
+            if m.kind == "histogram":
+                cum = 0
+                for le, c in zip(BUCKET_LE, m.buckets):
+                    cum += c
+                    lines.append(f'{q}_bucket{{le="{le}"}} {cum}')
+                lines.append(f"{q}_sum {m.sum}")
+                lines.append(f"{q}_count {cum}")
+            else:
+                lines.append(f"{q} {m.value}")
+        return "\n".join(lines) + "\n"
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+    def snapshot(self) -> dict:
+        """Plain-dict view: {"counters": {...}, "gauges": {...},
+        "histograms": {name: {"buckets", "sum", "count"}}}."""
+        with self._lock:
+            out = {"counters": {}, "gauges": {}, "histograms": {}}
+            for name, m in self._metrics.items():
+                if m.kind == "counter":
+                    out["counters"][name] = m.value
+                elif m.kind == "gauge":
+                    out["gauges"][name] = m.value
+                else:
+                    out["histograms"][name] = {
+                        "buckets": list(m.buckets),
+                        "sum": m.sum,
+                        "count": m.count,
+                    }
+            return out
