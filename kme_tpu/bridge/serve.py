@@ -73,6 +73,38 @@ def main(argv=None) -> int:
                    help="write a Chrome trace-event JSON (chrome://"
                         "tracing / Perfetto) of the engine phase "
                         "timeline here at exit")
+    p.add_argument("--journal-out", default=None, metavar="PATH",
+                   help="order-lifecycle flight recorder: append every "
+                        "order's journey (submit/accept/reject/fills/"
+                        "rest/cancel/payout with provenance stamps) "
+                        "here; .bin/.kmej selects the compact binary "
+                        "framing, anything else JSONL. Query with "
+                        "kme-trace")
+    p.add_argument("--journal-rotate-mb", type=int, default=None,
+                   metavar="MB", help="rotate the journal (logrotate-"
+                        "style PATH -> PATH.1 shifts) once the live "
+                        "file exceeds MB MiB")
+    p.add_argument("--journal-fsync", choices=("off", "batch"),
+                   default="off",
+                   help="batch = fsync the journal after every batch "
+                        "(bounds loss to one batch); off = OS "
+                        "buffering, flushed at checkpoints and exit")
+    p.add_argument("--audit", action="store_true",
+                   help="run the continuous invariant auditor in-"
+                        "process: a shadow ledger replays the journal "
+                        "stream per batch and checks conservation "
+                        "invariants; violations increment "
+                        "audit_violations, mark the heartbeat degraded "
+                        "and dump a minimized repro (fixed mode only; "
+                        "requires --journal-out)")
+    p.add_argument("--audit-repro-dir", default=None, metavar="DIR",
+                   help="write audit violation repro dumps here "
+                        "(replayable with kme-trace --replay-repro)")
+    p.add_argument("--annotate-rejects", action="store_true",
+                   help="emit an ADDITIVE 'REJ'-keyed MatchOut record "
+                        "naming each rejected order's rej_* reason "
+                        "code (the IN/OUT stream stays byte-identical "
+                        "to the reference)")
     args = p.parse_args(argv)
 
     import os
@@ -112,7 +144,13 @@ def main(argv=None) -> int:
                        max_fills=args.max_fills, width=args.width,
                        shards=args.shards, strict=args.strict,
                        checkpoint_dir=args.checkpoint_dir,
-                       checkpoint_every=args.checkpoint_every)
+                       checkpoint_every=args.checkpoint_every,
+                       journal=args.journal_out,
+                       journal_rotate_mb=args.journal_rotate_mb,
+                       journal_fsync=args.journal_fsync,
+                       audit=args.audit,
+                       audit_repro_dir=args.audit_repro_dir,
+                       annotate_rejects=args.annotate_rejects)
     msrv = None
     if args.metrics_port is not None:
         from kme_tpu.telemetry import start_metrics_server
@@ -137,6 +175,11 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        svc.close()     # flush + close the flight recorder
+        if args.journal_out is not None and os.path.exists(
+                args.journal_out):
+            print(f"kme-serve: journal written to {args.journal_out}",
+                  file=sys.stderr)
         if msrv is not None:
             msrv.shutdown()
         if tracer is not None:

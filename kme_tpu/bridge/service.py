@@ -40,7 +40,12 @@ class MatchService:
                  width: int = 8, shards: int = 1,
                  strict: bool = False,
                  checkpoint_dir: Optional[str] = None,
-                 checkpoint_every: int = 4096) -> None:
+                 checkpoint_every: int = 4096,
+                 journal=None, journal_rotate_mb: Optional[int] = None,
+                 journal_fsync: str = "off",
+                 audit: bool = False,
+                 audit_repro_dir: Optional[str] = None,
+                 annotate_rejects: bool = False) -> None:
         if engine not in ("lanes", "seq", "oracle", "native"):
             raise ValueError(f"unknown engine {engine!r}")
         if compat not in ("java", "fixed"):
@@ -65,11 +70,19 @@ class MatchService:
         self._req_symbols, self._req_accounts = symbols, accounts
         self._req_slots, self._req_max_fills = slots, max_fills
         self._last_engine_pub = 0.0
+        self._journal_arg = journal
+        self._journal_rotate_mb = journal_rotate_mb
+        self._journal_fsync = journal_fsync
+        self._audit_arg = audit
+        self._audit_repro_dir = audit_repro_dir
+        self.annotate_rejects = annotate_rejects
+        self.degraded = None        # set by the invariant auditor
         resumed = False
         if checkpoint_dir is not None:
             resumed = self._try_resume(engine, compat, shards, width)
         if resumed:
             self._init_telemetry()
+            self._init_observability(resumed=True)
             return
         if engine == "lanes":
             from kme_tpu.engine.lanes import LaneConfig
@@ -97,6 +110,74 @@ class MatchService:
         else:
             raise ValueError(f"unknown engine {engine!r}")
         self._init_telemetry()
+        self._init_observability(resumed=False)
+
+    def _init_observability(self, resumed: bool) -> None:
+        """Flight recorder + invariant auditor wiring. The journal
+        subscribes the auditor as an observer, so the shadow replay
+        sees exactly what lands in the journal file; on resume the
+        journal is rewound to the snapshot offset (the at-least-once
+        tail replay would otherwise journal twice) and the auditor is
+        seeded from the restored engine state."""
+        import os
+
+        from kme_tpu.telemetry import InvariantAuditor, Journal
+
+        self.journal = None
+        self.auditor = None
+        j = self._journal_arg
+        if isinstance(j, str):
+            rb = (self._journal_rotate_mb * (1 << 20)
+                  if self._journal_rotate_mb else None)
+            j = Journal(j, rotate_bytes=rb, fsync=self._journal_fsync)
+        self.journal = j
+        if j is not None and resumed:
+            j.rewind_to_offset(self.offset)
+        if not self._audit_arg:
+            return
+        if self._compat != "fixed":
+            print("kme-serve: --audit needs fixed-mode money semantics; "
+                  "auditing disabled for compat=java", file=sys.stderr)
+            return
+        if j is None:
+            raise ValueError("--audit requires --journal-out (the "
+                             "auditor replays the journal stream)")
+
+        def on_violation(violations, dump):
+            self.degraded = violations[0]["kind"]
+            where = f" (repro: {dump})" if dump else ""
+            print(f"kme-serve: AUDIT VIOLATION {violations[0]}{where}",
+                  file=sys.stderr)
+
+        self.auditor = InvariantAuditor(
+            registry=self.telemetry, repro_dir=self._audit_repro_dir,
+            on_violation=on_violation,
+            checkpoint_ref=self.checkpoint_dir)
+        if resumed and self._session is not None:
+            self.auditor.seed(self._session.export_state(),
+                              self._session.histograms())
+        # deliberate-corruption hook for end-to-end violation tests:
+        # KME_AUDIT_TAMPER=fill_qty bumps the first journaled fill's
+        # quantity by one, which must trip the auditor
+        if os.environ.get("KME_AUDIT_TAMPER") == "fill_qty":
+            done = []
+
+            def tamper(events):
+                if not done:
+                    for ev in events:
+                        if ev.get("e") == "fill":
+                            ev["qty"] += 1
+                            done.append(True)
+                            break
+                return events
+
+            self.auditor.tamper = tamper
+        j.observers.append(self.auditor.observe)
+
+    def close(self) -> None:
+        """Flush + close the flight recorder (serve shutdown path)."""
+        if getattr(self, "journal", None) is not None:
+            self.journal.close()
 
     def _init_telemetry(self) -> None:
         """The service's metrics surface (/metrics, heartbeat). Session
@@ -250,6 +331,15 @@ class MatchService:
         else:
             ck.save_oracle(self.checkpoint_dir, self._oracle, self.offset)
         self._last_ckpt_offset = self.offset
+        if self.journal is not None:
+            # the journal is best-effort relative to the broker log, but
+            # a snapshot is a natural durability point for it too
+            self.journal.flush()
+        if self.auditor is not None and self._session is not None:
+            # checkpoint-cadence cross-check: shadow ledger vs the
+            # engine's exported stores + device histograms
+            self.auditor.check_engine(self._session.export_state(),
+                                      self._session.histograms())
 
     # ------------------------------------------------------------------
 
@@ -292,14 +382,18 @@ class MatchService:
             return 0
         if not recs:
             return 0
-        msgs = []
+        msgs, offs, drops = [], [], []
         for r in recs:
             m = self._parse(r.value)
             if m is not None:
                 msgs.append(m)
+                offs.append(r.offset)
+            else:
+                drops.append((-1, r.offset))
+        out = reasons = None
         if msgs:
             if self._native is not None:
-                self._native_produce(msgs)
+                out = self._native_produce(msgs)
             elif self._session is not None:
                 try:
                     out = self._session.process_wire(msgs)
@@ -317,17 +411,23 @@ class MatchService:
                     # continues there — the batch replays on the
                     # native engine from the same state
                     self._degrade_to_native(str(e))
-                    self._native_produce(msgs)
-                    out = None
-                if out is not None:
+                    out = self._native_produce(msgs)
+                else:
+                    reasons = self._session.last_reasons
                     self._produce_lines(out)
             else:
                 from kme_tpu.wire import dumps_order
 
-                for m in msgs:
-                    for rec in self._oracle.process(m):
-                        self.broker.produce(TOPIC_OUT, rec.key,
-                                            dumps_order(rec.value))
+                out = [[f"{rec.key} {dumps_order(rec.value)}"
+                        for rec in self._oracle.process(m)]
+                       for m in msgs]
+                self._produce_lines(out)
+            if self.annotate_rejects and out is not None:
+                self._produce_rej_annotations(out, reasons)
+        if self.journal is not None and (out or drops):
+            self.journal.record_batch(out or [], reasons=reasons,
+                                      offsets=offs[:len(out or [])],
+                                      drops=drops)
         # batch-boundary commit (H5): offsets advance only after the
         # outputs for the whole batch are on MatchOut
         self.offset = recs[-1].offset + 1
@@ -359,13 +459,35 @@ class MatchService:
                 key, _, value = ln.partition(" ")
                 self.broker.produce(TOPIC_OUT, key, value)
 
-    def _native_produce(self, msgs) -> None:
+    def _native_produce(self, msgs):
         # byte-faithful death handling: forward every completed
         # message's records, THEN die like the reference thread
         out, exc = self._native.process_wire_partial(msgs)
         self._produce_lines(out)
         if exc is not None:
             raise exc
+        return out
+
+    def _produce_rej_annotations(self, out, reasons) -> None:
+        """Opt-in per-order reject causes as ADDITIVE "REJ"-keyed
+        MatchOut records (wire.rej_record_json) — the IN/OUT stream
+        stays byte-identical to the reference. Engines without exact
+        codes (native/oracle) get the action heuristic."""
+        import json
+
+        from kme_tpu.wire import (REJ_UNSPECIFIED, reason_for_reject,
+                                  rej_record_json)
+
+        for i, lines in enumerate(out):
+            if not lines or '"action":7,' not in lines[-1]:
+                continue
+            m = json.loads(lines[0].partition(" ")[2])
+            code = (int(reasons[i]) if reasons is not None
+                    else reason_for_reject(m["action"]))
+            if code == 0:
+                code = REJ_UNSPECIFIED
+            self.broker.produce(TOPIC_OUT, "REJ", rej_record_json(
+                m["oid"], m["aid"], code))
 
     def _degrade_to_native(self, reason: str) -> None:
         """One-way engine degradation for java-mode streams that leave
@@ -488,5 +610,6 @@ class MatchService:
             json.dump({"pid": os.getpid(), "time": _t.time(),
                        "seen": seen, "offset": self.offset,
                        "tick": tick,
+                       "degraded": self.degraded,
                        "metrics": self.telemetry.snapshot()}, f)
         os.replace(tmp, path)

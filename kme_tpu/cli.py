@@ -122,6 +122,201 @@ def provision_main(argv=None) -> int:
     return _main(argv)
 
 
+def _fmt_event(ev: dict) -> str:
+    from kme_tpu.wire import rej_name
+
+    bits = [f"seq={ev.get('seq', '?')}",
+            f"b={ev.get('b', '?')}:{ev.get('i', '?')}",
+            f"off={ev.get('off', -1)}",
+            f"{ev['e']:<13s}"]
+    for k in ("oid", "aid", "sid", "px", "qty", "moid", "maid"):
+        if k in ev:
+            bits.append(f"{k}={ev[k]}")
+    if ev.get("rej"):
+        bits.append(f"rej={rej_name(ev['rej'])}")
+    if "ts" in ev:
+        import datetime
+
+        t = datetime.datetime.fromtimestamp(ev["ts"] / 1e6,
+                                            datetime.timezone.utc)
+        bits.append(t.strftime("%H:%M:%S.%f"))
+    return "  ".join(bits)
+
+
+def _trace_self_check() -> int:
+    """Synthetic end-to-end smoke: journal a canned stream through both
+    framings, reconstruct a lifecycle, and byte-compare against the
+    oracle replay. Exit 0 only if every step agrees (used by CI)."""
+    import os
+    import tempfile
+
+    from kme_tpu.oracle import OracleEngine
+    from kme_tpu.telemetry.journal import (
+        Journal, canonical_lines, lifecycle_summary, oracle_events,
+        order_lifecycle, read_events)
+    from kme_tpu.wire import dumps_order, parse_order
+    from kme_tpu.workload import harness_stream
+
+    msgs = harness_stream(400, seed=7, num_accounts=6, num_symbols=2,
+                          payout_opcode_bug=False, validate=True)
+    lines = [dumps_order(m) for m in msgs]
+    eng = OracleEngine("fixed")
+    out = [[rec.wire() for rec in eng.process(parse_order(ln))]
+           for ln in lines]
+    ok = True
+    with tempfile.TemporaryDirectory() as td:
+        for ext in ("jsonl", "bin"):
+            path = os.path.join(td, f"sc.{ext}")
+            j = Journal(path)
+            for lo in range(0, len(out), 100):
+                j.record_batch(out[lo:lo + 100],
+                               offsets=list(range(lo, lo + 100)))
+            j.close()
+            evs = read_events(path)
+            want = canonical_lines(oracle_events(lines))
+            got = canonical_lines(evs)
+            if got != want:
+                print(f"kme-trace --self-check: {ext} journal does not "
+                      f"match oracle replay ({len(got)} vs {len(want)} "
+                      "events)", file=sys.stderr)
+                ok = False
+                continue
+            seqs = [e["seq"] for e in evs]
+            if seqs != sorted(set(seqs)):
+                print(f"kme-trace --self-check: {ext} seq numbers not "
+                      "strictly monotonic", file=sys.stderr)
+                ok = False
+                continue
+            oids = [e["oid"] for e in evs
+                    if e["e"] == "fill" and "oid" in e]
+            if oids:
+                life = order_lifecycle(evs, oids[0])
+                summ = lifecycle_summary(life, oids[0])
+                if not life or summ["filled"] <= 0:
+                    print("kme-trace --self-check: lifecycle "
+                          "reconstruction came back empty",
+                          file=sys.stderr)
+                    ok = False
+    print("kme-trace --self-check: "
+          + ("OK" if ok else "FAILED"), file=sys.stderr)
+    return 0 if ok else 1
+
+
+def trace_main(argv=None) -> int:
+    """Flight-recorder query tool: reconstruct one order's or account's
+    lifecycle from a journal written by kme-serve --journal-out (or
+    kme-bench --journal-out), verify a journal against the reference
+    oracle replay, or replay an audit violation repro dump."""
+    p = argparse.ArgumentParser(prog="kme-trace",
+                                description=trace_main.__doc__)
+    p.add_argument("journal", nargs="?", default=None,
+                   help="journal path (.jsonl or .bin/.kmej; rotated "
+                        "PATH.N siblings are read automatically)")
+    p.add_argument("--order", type=int, default=None, metavar="OID",
+                   help="print every event touching this order id "
+                        "(taker or resting maker side) plus a terminal-"
+                        "state summary")
+    p.add_argument("--account", type=int, default=None, metavar="AID",
+                   help="print every event touching this account id")
+    p.add_argument("--limit", type=int, default=None, metavar="N",
+                   help="print at most the last N matching events")
+    p.add_argument("--json", action="store_true",
+                   help="emit raw event JSON lines instead of the "
+                        "pretty rendering")
+    p.add_argument("--no-rotated", action="store_true",
+                   help="read only the live file, ignore PATH.N "
+                        "rotation siblings")
+    p.add_argument("--verify", default=None, metavar="INPUT",
+                   help="replay this order-JSONL input through the "
+                        "Python oracle and byte-compare the canonical "
+                        "event stream against the journal (exit 1 on "
+                        "divergence)")
+    p.add_argument("--compat", choices=("java", "fixed"),
+                   default="fixed", help="oracle compat for --verify")
+    p.add_argument("--book-slots", type=int, default=None,
+                   help="capacity envelope for --verify (match the "
+                        "serving engine's --slots)")
+    p.add_argument("--max-fills", type=int, default=None,
+                   help="per-order fill cap for --verify (match the "
+                        "serving engine's --max-fills)")
+    p.add_argument("--replay-repro", default=None, metavar="DUMP",
+                   help="re-run the invariant auditor over an "
+                        "audit_repro_*.json violation dump (exit 1 if "
+                        "the violation reproduces)")
+    p.add_argument("--self-check", action="store_true",
+                   help="synthetic round-trip smoke test (no journal "
+                        "needed); exit 0 iff journal/oracle/lifecycle "
+                        "machinery agrees")
+    args = p.parse_args(argv)
+    import json
+
+    if args.self_check:
+        return _trace_self_check()
+    if args.replay_repro is not None:
+        from kme_tpu.telemetry.audit import replay_repro
+
+        found = replay_repro(args.replay_repro)
+        for v in found:
+            print(json.dumps(v))
+        print(f"kme-trace: repro {'REPRODUCED' if found else 'clean'} "
+              f"({len(found)} violation(s))", file=sys.stderr)
+        return 1 if found else 0
+    if args.journal is None:
+        p.error("a journal path is required (or --self-check / "
+                "--replay-repro)")
+    from kme_tpu.telemetry.journal import (
+        account_history, canonical_lines, lifecycle_summary,
+        oracle_events, order_lifecycle, read_events)
+
+    events = read_events(args.journal,
+                         include_rotated=not args.no_rotated)
+    if args.verify is not None:
+        with open(args.verify) as fh:
+            lines = [ln.strip() for ln in fh if ln.strip()]
+        want = canonical_lines(oracle_events(
+            lines, compat=args.compat, book_slots=args.book_slots,
+            max_fills=args.max_fills))
+        got = canonical_lines(events)
+        if got == want:
+            print(f"kme-trace: journal matches oracle replay "
+                  f"({len(got)} events)", file=sys.stderr)
+            return 0
+        n = min(len(got), len(want))
+        div = next((k for k in range(n) if got[k] != want[k]), n)
+        print(f"kme-trace: DIVERGENCE at canonical event {div} "
+              f"(journal {len(got)} events, oracle {len(want)})",
+              file=sys.stderr)
+        if div < len(got):
+            print(f"  journal: {got[div]}", file=sys.stderr)
+        if div < len(want):
+            print(f"  oracle:  {want[div]}", file=sys.stderr)
+        return 1
+    if args.order is not None:
+        picked = order_lifecycle(events, args.order)
+        summary = lifecycle_summary(picked, args.order)
+    elif args.account is not None:
+        picked = account_history(events, args.account)
+        summary = None
+    else:
+        picked, summary = events, None
+    if args.limit is not None:
+        picked = picked[-args.limit:]
+    for ev in picked:
+        print(json.dumps(ev) if args.json else _fmt_event(ev))
+    if summary is not None:
+        print(f"kme-trace: order {summary['oid']} state="
+              f"{summary['state']} filled={summary['filled']} "
+              f"rested={summary['rested']} "
+              f"events={summary['events']}", file=sys.stderr)
+    elif args.order is None and args.account is None:
+        from collections import Counter as _Counter
+
+        kinds = _Counter(e["e"] for e in events)
+        print("kme-trace: " + " ".join(
+            f"{k}={kinds[k]}" for k in sorted(kinds)), file=sys.stderr)
+    return 0
+
+
 def supervise_main(argv=None) -> int:
     """Failure detection + supervised restart of kme-serve."""
     try:
@@ -135,14 +330,14 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m kme_tpu.cli")
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
-        "supervise"))
+        "supervise", "trace"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
             "loadgen": loadgen_main, "oracle": oracle_main,
             "bench": bench_main, "serve": serve_main,
             "consume": consume_main, "provision": provision_main,
-            "supervise": supervise_main,
+            "supervise": supervise_main, "trace": trace_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

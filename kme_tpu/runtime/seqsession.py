@@ -29,7 +29,8 @@ from kme_tpu.runtime import session as _session
 from kme_tpu.runtime.session import LaneEngineError
 from kme_tpu.runtime.sequencer import CapacityError, EnvelopeError
 from kme_tpu.telemetry import PhaseTimer, Registry
-from kme_tpu.wire import OrderMsg, OutRecord, WireBatch, order_json
+from kme_tpu.wire import (OrderMsg, OutRecord, WireBatch, order_json,
+                          reject_reason_codes)
 
 # register the seq-specific sticky-error name so LaneEngineError renders
 # it (the code space is shared with the lanes engine's LERR_*)
@@ -406,6 +407,15 @@ class SeqSession:
         # adaptive fill-slice hint (fill groups per call fetched in the
         # single-round fetch; grows to the observed high-water mark)
         self._ghint = 8
+        # per-message REJ_* reason codes for the last processed batch
+        # (np.uint8 (nmsg,), wire.REJ_NAMES) — the flight recorder and
+        # the REJ annotation records read this after each batch
+        self.last_reasons = None
+        # ("submit"|"collect", pipeline-batch-idx, t0, t1) wall windows
+        # from the pipelined path, for measured-overlap reporting
+        self.windows: List[tuple] = []
+        self._n_submit = 0
+        self._n_collect = 0
 
     # ------------------------------------------------------------------
 
@@ -527,6 +537,9 @@ class SeqSession:
         double-buffered serving shape (SURVEY.md §7 H5): the device
         executes batch N+1 while the host fetches and reconstructs
         batch N."""
+        from time import perf_counter
+
+        t0 = perf_counter()
         if not isinstance(msgs, WireBatch):
             try:
                 msgs = WireBatch.from_msgs(msgs)
@@ -537,16 +550,26 @@ class SeqSession:
         cols, host_rejects, stacked, cnts, K = self._plan(msgs)
         self.state, outp = SQ.build_seq_scan(self.cfg, K)(
             self.state, stacked)
+        self.windows.append(("submit", self._n_submit, t0,
+                             perf_counter()))
+        self._n_submit += 1
         return (msgs, cols, host_rejects, outp, cnts, K)
 
     def collect(self, handle):
         """Complete a submit(): fetch + reconstruct the byte stream.
         Returns (buf, line_off, msg_lines) like process_wire_buffer
         (requires the native reconstructor and a WireBatch handle)."""
+        from time import perf_counter
+
+        t0 = perf_counter()
         batch, cols, host_rejects, outp, cnts, K = handle
         host, fills = self._fetch_outputs(outp, cnts, K)
-        return self._recon_buffer(batch, cols, host_rejects, host,
-                                  fills)
+        r = self._recon_buffer(batch, cols, host_rejects, host,
+                               fills)
+        self.windows.append(("collect", self._n_collect, t0,
+                             perf_counter()))
+        self._n_collect += 1
+        return r
 
     # ------------------------------------------------------------------
 
@@ -596,6 +619,9 @@ class SeqSession:
                 "for the pipelined/buffer serving path — use "
                 "process_wire on hosts without the native toolchain")
         nmsg = batch.n
+        self.last_reasons = reject_reason_codes(
+            nmsg, cols["msg_index"], cols["act"], host["ok"],
+            host["cap_reject"], host_rejects)
         m_action, m_oid, m_aid = batch.action, batch.oid, batch.aid
         m_sid, m_price, m_size = batch.sid, batch.price, batch.size
         m_next, m_hnext = batch.next, batch.hnext
@@ -689,6 +715,9 @@ class SeqSession:
         lane_to_sid = self.router.sid_of_lane()
 
         nmsg = len(msgs)
+        self.last_reasons = reject_reason_codes(
+            nmsg, cols["msg_index"], cols["act"], host["ok"],
+            host["cap_reject"], host_rejects)
         ok_of = [False] * nmsg
         nfill_of = [0] * nmsg
         off_of = [0] * nmsg
@@ -759,6 +788,9 @@ class SeqSession:
         idx_to_aid = self.router.acct_of_idx()
         lane_to_sid = self.router.sid_of_lane()
         nmsg = len(msgs)
+        self.last_reasons = reject_reason_codes(
+            nmsg, cols["msg_index"], cols["act"], host["ok"],
+            host["cap_reject"], host_rejects)
         dev = {}
         offs = np.cumsum(host["nfill"]) - host["nfill"] \
             if len(cols["msg_index"]) else np.zeros(0)

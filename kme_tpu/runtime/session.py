@@ -30,11 +30,24 @@ from kme_tpu import opcodes as op
 from kme_tpu.engine import lanes as L
 from kme_tpu.runtime.sequencer import Schedule, make_scheduler
 from kme_tpu.telemetry import PhaseTimer, Registry
+from kme_tpu import wire as W
 from kme_tpu.wire import OrderMsg, OutRecord
 
 _LERR_NAMES = {
     L.LERR_FILLBUF_FULL: "session fill log exhausted (fill_buffer knob)",
 }
+
+
+def _device_reason(lane_act: int, cap: bool) -> int:
+    """REJ_* code for a device not-ok result: the capacity flag wins,
+    else classify by the internal lane act."""
+    if cap:
+        return W.REJ_CAPACITY
+    if lane_act in (L.L_BUY, L.L_SELL):
+        return W.REJ_RISK
+    if lane_act == L.L_CANCEL:
+        return W.REJ_CANCEL
+    return W.REJ_OTHER
 
 
 class LaneEngineError(RuntimeError):
@@ -110,6 +123,10 @@ class LaneSession:
         self.timer = PhaseTimer(track="lanes")
         # the timer owns the dict: phase totals ACCUMULATE across batches
         self.phases = self.timer.totals
+        # per-message REJ_* reason codes for the last processed batch
+        # (np.uint8 (nmsg,), wire.REJ_NAMES) — read by the flight
+        # recorder and the opt-in REJ annotation records
+        self.last_reasons = None
 
     # ------------------------------------------------------------------
 
@@ -266,13 +283,15 @@ class LaneSession:
         append_of = [False] * nmsg
         act_of = [0] * nmsg
         lane_of = [0] * nmsg
+        cap_of = [False] * nmsg
         for run in runs:
             n = len(run.idx)
             h = run.host
             mis = cols["msg_index"][run.idx].tolist()
             for name, dst in (("ok", ok_of), ("nfill", nfill_of),
                               ("residual", resid_of), ("prev_oid", prev_of),
-                              ("append", append_of)):
+                              ("append", append_of),
+                              ("cap_reject", cap_of)):
                 vals = h[name][:n].tolist()
                 for k, mi in enumerate(mis):
                     dst[mi] = vals[k]
@@ -289,12 +308,15 @@ class LaneSession:
 
         from kme_tpu.wire import order_json
 
+        reasons = np.zeros(nmsg, np.uint8)
         out: List[List[str]] = []
         for i, m in enumerate(msgs):
             in_body = order_json(m.action, m.oid, m.aid, m.sid, m.price,
                                  m.size, m.next, m.prev)
             lines = [f'IN {in_body}']
             if i in rejects or (i in barriers and not barrier_ok[i]):
+                reasons[i] = (W.REJ_UNROUTABLE if i in rejects
+                              else W.REJ_BARRIER)
                 lines.append('OUT ' + order_json(
                     op.REJECT, m.oid, m.aid, m.sid, m.price, m.size,
                     m.next, m.prev))
@@ -325,10 +347,13 @@ class LaneSession:
                         resid_of[i], m.next,
                         prev_of[i] if append_of[i] else m.prev))
                 else:
+                    if not ok:
+                        reasons[i] = _device_reason(lane_act, cap_of[i])
                     lines.append('OUT ' + order_json(
                         m.action if ok else op.REJECT, m.oid, m.aid,
                         m.sid, m.price, m.size, m.next, m.prev))
             out.append(lines)
+        self.last_reasons = reasons
         return out
 
     def _reconstruct(self, msgs, sched, runs, barrier_ok_dev, fills):
@@ -348,16 +373,19 @@ class LaneSession:
         rejects = {r.msg_index for r in sched.host_rejects}
         barriers_by_msg = {b.msg_index: b for b in sched.barriers}
 
+        reasons = np.zeros(len(msgs), np.uint8)
         out: List[List[OutRecord]] = []
         for i, m in enumerate(msgs):
             recs = [OutRecord("IN", m.copy())]
             if i in rejects:
+                reasons[i] = W.REJ_UNROUTABLE
                 echo = m.copy()
                 echo.action = op.REJECT
                 recs.append(OutRecord("OUT", echo))
             elif i in barriers_by_msg:
                 echo = m.copy()
                 if not barrier_ok[i]:
+                    reasons[i] = W.REJ_BARRIER
                     echo.action = op.REJECT
                 recs.append(OutRecord("OUT", echo))
             else:
@@ -386,6 +414,8 @@ class LaneSession:
                             price=m.price - mprice, size=fsz)))
                 echo = m.copy()
                 if not ok:
+                    reasons[i] = _device_reason(
+                        lane_act, bool(h["cap_reject"][mm]))
                     echo.action = op.REJECT
                 if is_trade and ok:
                     echo.size = int(h["residual"][mm])
@@ -393,6 +423,7 @@ class LaneSession:
                         echo.prev = int(h["prev_oid"][mm])
                 recs.append(OutRecord("OUT", echo))
             out.append(recs)
+        self.last_reasons = reasons
         return out
 
     # ------------------------------------------------------------------

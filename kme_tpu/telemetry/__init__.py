@@ -1,8 +1,11 @@
-"""Unified telemetry: metric registry, phase tracing, live HTTP surface.
+"""Unified telemetry: metric registry, phase tracing, live HTTP surface,
+order-lifecycle flight recorder, and continuous invariant auditing.
 
 - registry: Counter/Gauge/Histogram + Prometheus text + JSON export
 - trace: PhaseTimer spans + Chrome trace-event recording
 - httpd: stdlib /metrics endpoint over a Registry
+- journal: append-only lifecycle journal (jsonl/binary) + readers
+- audit: shadow-ledger invariant auditor over the journal
 """
 
 from kme_tpu.telemetry.registry import (  # noqa: F401
@@ -21,3 +24,18 @@ from kme_tpu.telemetry.trace import (  # noqa: F401
     install,
 )
 from kme_tpu.telemetry.httpd import start_metrics_server  # noqa: F401
+from kme_tpu.telemetry.journal import (  # noqa: F401
+    Journal,
+    batch_events,
+    canonical_events,
+    canonical_lines,
+    iter_events,
+    measured_overlap_s,
+    oracle_events,
+    read_events,
+)
+from kme_tpu.telemetry.audit import (  # noqa: F401
+    InvariantAuditor,
+    Violation,
+    replay_repro,
+)

@@ -27,6 +27,108 @@ from typing import Iterator, Optional
 
 _FIELDS = ("action", "oid", "aid", "sid", "price", "size")
 
+# ---------------------------------------------------------------------------
+# Reject reason codes (wire-level / journal-level).
+#
+# The reference collapses every refusal into an action=7 REJECT echo with
+# no cause; the device engines DO know why (the rej_* metric counters of
+# engine/lanes.py / engine/seq.py are incremented per cause). This table
+# names the per-order code the sessions surface alongside reconstruction
+# (`last_reasons`), the flight-recorder journal records, and the opt-in
+# "REJ"-keyed MatchOut annotation carries. The default IN/OUT stream is
+# byte-pinned against the reference and never changes; reason codes ride
+# in ADDITIVE records/journals only.
+#
+#   code  name             meaning
+#   0     ok               not rejected
+#   1     rej_capacity     device capacity envelope (book slots / fill
+#                          buffer) refused the order
+#   2     rej_risk         margin/balance check or fixed-mode validation
+#                          (price domain, missing book) failed
+#   3     rej_cancel       cancel target unknown to the book / not owned
+#   4     rej_unroutable   host router resolved the reject (unknown-oid
+#                          cancel, unmapped payout/remove, bad action)
+#   5     rej_barrier      payout/remove barrier refused on device
+#   6     rej_malformed    record dropped before the engine (serde)
+#   7     rej_other        non-trade device op refused (create/transfer/
+#                          add_symbol)
+#   8     rej_unspecified  host engines (native/oracle) report no cause
+REJ_NONE = 0
+REJ_CAPACITY = 1
+REJ_RISK = 2
+REJ_CANCEL = 3
+REJ_UNROUTABLE = 4
+REJ_BARRIER = 5
+REJ_MALFORMED = 6
+REJ_OTHER = 7
+REJ_UNSPECIFIED = 8
+
+REJ_NAMES = {
+    REJ_NONE: "ok",
+    REJ_CAPACITY: "rej_capacity",
+    REJ_RISK: "rej_risk",
+    REJ_CANCEL: "rej_cancel",
+    REJ_UNROUTABLE: "rej_unroutable",
+    REJ_BARRIER: "rej_barrier",
+    REJ_MALFORMED: "rej_malformed",
+    REJ_OTHER: "rej_other",
+    REJ_UNSPECIFIED: "rej_unspecified",
+}
+
+
+def rej_name(code: int) -> str:
+    return REJ_NAMES.get(code, f"rej_{code}")
+
+
+def reason_for_reject(action: int) -> int:
+    """Heuristic reason for engines that report no per-order cause
+    (native/oracle): classify by the rejected wire action. Device
+    sessions report exact codes instead (runtime/session.py)."""
+    if action in (2, 3):          # BUY / SELL
+        return REJ_RISK
+    if action == 4:               # CANCEL
+        return REJ_CANCEL
+    if action in (1, 200):        # REMOVE_SYMBOL / PAYOUT
+        return REJ_BARRIER
+    if action in (0, 100, 101):   # ADD_SYMBOL / CREATE / TRANSFER
+        return REJ_OTHER
+    return REJ_UNSPECIFIED
+
+
+def reject_reason_codes(nmsg, msg_index, act, ok, cap_reject, host_rejects):
+    """Vectorized per-message reason codes from one device batch's
+    routing + results: host-resolved rejects are unroutable; a device
+    not-ok is capacity when the cap flag fired, else classified by the
+    internal lane act (1/2 trade -> risk, 3 cancel, 7/8/9 barrier,
+    other device ops -> other). Returns a (nmsg,) uint8 array."""
+    import numpy as np
+
+    reasons = np.zeros(nmsg, np.uint8)
+    if host_rejects:
+        reasons[list(host_rejects)] = REJ_UNROUTABLE
+    if len(msg_index):
+        act = np.asarray(act)
+        bad = ~np.asarray(ok, bool)
+        by_act = np.where(
+            (act == 1) | (act == 2), REJ_RISK,
+            np.where(act == 3, REJ_CANCEL,
+                     np.where((act >= 7) & (act <= 9), REJ_BARRIER,
+                              REJ_OTHER)))
+        r = np.where(np.asarray(cap_reject, bool), REJ_CAPACITY,
+                     by_act).astype(np.uint8)
+        mi = np.asarray(msg_index)
+        reasons[mi[bad]] = r[bad]
+    return reasons
+
+
+def rej_record_json(oid: int, aid: int, code: int) -> str:
+    """The value of an opt-in "REJ"-keyed MatchOut annotation record
+    (kme-serve --annotate-rejects): compact JSON naming the per-order
+    reject cause. ADDITIVE — consumers keyed on IN/OUT are unaffected
+    and the default stream stays byte-identical to the reference."""
+    return (f'{{"oid":{oid},"aid":{aid},"reason":{code},'
+            f'"rej":"{rej_name(code)}"}}')
+
 
 @dataclasses.dataclass
 class OrderMsg:

@@ -23,6 +23,66 @@ def test_kme_oracle_pipe_roundtrip():
     assert r.stdout.splitlines() == want
 
 
+def test_kme_trace_self_check():
+    """The CI smoke: synthetic journal/oracle/lifecycle round-trip."""
+    r = subprocess.run(
+        [sys.executable, "-m", "kme_tpu.cli", "trace", "--self-check"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stderr
+
+
+def test_kme_trace_query_and_verify(tmp_path):
+    """Write a journal from an oracle run, then reconstruct one order
+    and verify the whole file against an independent replay."""
+    import json
+
+    from kme_tpu.telemetry.journal import Journal
+    from kme_tpu.wire import parse_order
+
+    msgs = harness_stream(200, seed=6, num_accounts=6, num_symbols=2,
+                          payout_opcode_bug=False, validate=True)
+    lines = [dumps_order(m) for m in msgs]
+    inp = tmp_path / "input.jsonl"
+    inp.write_text("\n".join(lines) + "\n")
+    eng = OracleEngine("fixed")
+    groups = [[r.wire() for r in eng.process(parse_order(ln))]
+              for ln in lines]
+    jp = str(tmp_path / "j.jsonl")
+    j = Journal(jp)
+    j.record_batch(groups, offsets=list(range(len(groups))))
+    j.close()
+
+    r = subprocess.run(
+        [sys.executable, "-m", "kme_tpu.cli", "trace", jp,
+         "--verify", str(inp)],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "matches oracle replay" in r.stderr
+
+    fill = next(json.loads(ln) for ln in open(jp)
+                if json.loads(ln)["e"] == "fill")
+    r = subprocess.run(
+        [sys.executable, "-m", "kme_tpu.cli", "trace", jp,
+         "--order", str(fill["oid"]), "--json"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    evs = [json.loads(ln) for ln in r.stdout.splitlines()]
+    assert [e["e"] for e in evs][:2] == ["submit", "accept"]
+    assert any(e["e"] == "fill" for e in evs)
+    assert f"order {fill['oid']}" in r.stderr
+
+    # divergence detection: verify against a shuffled input must fail
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("\n".join(lines[::-1]) + "\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "kme_tpu.cli", "trace", jp,
+         "--verify", str(bad)],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 1
+    assert "DIVERGENCE" in r.stderr
+
+
 def test_kme_loadgen_stdout_deterministic():
     out = []
     for _ in range(2):

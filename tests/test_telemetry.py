@@ -262,6 +262,61 @@ def test_metrics_http_server():
         srv.shutdown()
 
 
+def test_metrics_http_concurrent_scrape_with_engine_steps():
+    """Scrapers hammering /metrics and /metrics.json WHILE the engine
+    steps and republishes must never see an error or torn exposition —
+    the registry surface is read concurrently with session writes."""
+    import threading
+
+    from kme_tpu.engine.lanes import LaneConfig
+    from kme_tpu.runtime.session import LaneSession
+
+    ses = LaneSession(LaneConfig(lanes=8, slots=32, accounts=32,
+                                 max_fills=16, steps=16))
+    msgs = _stream(400)
+    srv = start_metrics_server(ses.telemetry, 0, host="127.0.0.1")
+    host, port = srv.server_address[:2]
+    stop = threading.Event()
+    errs, bodies = [], []
+
+    def scrape():
+        while not stop.is_set():
+            try:
+                bodies.append(urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics",
+                    timeout=5).read().decode())
+                json.loads(urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics.json",
+                    timeout=5).read().decode())
+            except Exception as e:  # noqa: BLE001 - collected + asserted
+                errs.append(e)
+                return
+
+    threads = [threading.Thread(target=scrape) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for lo in range(0, len(msgs), 50):
+            ses.process_wire([m.copy() for m in msgs[lo:lo + 50]])
+            ses.metrics()        # republishes counters mid-scrape
+            ses.histograms()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        srv.shutdown()
+    assert errs == []
+    assert bodies
+    # post-publish scrapes carry complete histogram families
+    final = bodies[-1]
+    assert "# TYPE" in final
+    for text in bodies:
+        # exposition is never torn mid-family: every bucket line that
+        # appears belongs to a family whose _count line also appears
+        if "fills_per_order_bucket" in text:
+            assert "fills_per_order_count" in text
+
+
 # ---------------------------------------------------------------------------
 # checkpoint round-trips: counters and histogram buckets are part of the
 # resume contract (a restart must not zero the operator's dashboards)
