@@ -29,9 +29,19 @@ import sys
 import threading
 from typing import Dict, IO, List, Optional
 
+from kme_tpu import faults
+
 
 class BrokerError(RuntimeError):
     pass
+
+
+class BrokerOverload(BrokerError):
+    """The bounded ingress queue shed this produce (wire-level
+    `rej_overload`, wire.py rej table code 9). Producers should back
+    off and retry; the broker never blocks them."""
+
+    code = "rej_overload"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,11 +63,21 @@ class InProcessBroker:
     """The broker API the rest of the bridge codes against. The TCP
     client (tcp.TcpBroker) implements the same three methods."""
 
-    def __init__(self, persist_dir: Optional[str] = None) -> None:
+    def __init__(self, persist_dir: Optional[str] = None,
+                 max_lag: Optional[int] = None) -> None:
         self._topics: Dict[str, _Topic] = {}
         self._lock = threading.Lock()
         self._data = threading.Condition(self._lock)
         self._persist_dir = persist_dir
+        # bounded ingress: once a consumer has committed a watermark for
+        # a topic (MatchService commits MatchIn each batch), producing
+        # more than `max_lag` records past it is refused with
+        # BrokerOverload instead of growing the backlog without bound —
+        # shed load, never stall. Topics without a watermark (MatchOut)
+        # are unbounded.
+        self._max_lag = max_lag
+        self._commits: Dict[str, int] = {}
+        self.overload_rejects = 0
         if persist_dir is not None:
             os.makedirs(persist_dir, exist_ok=True)
             for name in sorted(os.listdir(persist_dir)):
@@ -136,10 +156,20 @@ class InProcessBroker:
 
     def produce(self, topic: str, key: Optional[str], value: str) -> int:
         """Append one record; returns its offset."""
+        if faults.should("broker.produce"):
+            raise BrokerError("injected fault: broker.produce")
         with self._data:
             t = self._topics.get(topic)
             if t is None:
                 raise BrokerError(f"unknown topic {topic!r}")
+            if (self._max_lag is not None and topic in self._commits
+                    and len(t.log) - self._commits[topic]
+                    >= self._max_lag):
+                self.overload_rejects += 1
+                raise BrokerOverload(
+                    f"rej_overload: topic {topic!r} backlog "
+                    f"{len(t.log) - self._commits[topic]} >= max_lag "
+                    f"{self._max_lag}")
             off = len(t.log)
             t.log.append(Record(off, key, value))
             if t.logfile is not None:
@@ -153,6 +183,8 @@ class InProcessBroker:
               timeout: float = 0.0) -> List[Record]:
         """Records from `offset` (at most max_records). Blocks up to
         `timeout` seconds while the log end is <= offset."""
+        if faults.should("broker.fetch"):
+            raise BrokerError("injected fault: broker.fetch")
         with self._data:
             t = self._topics.get(topic)
             if t is None:
@@ -161,6 +193,15 @@ class InProcessBroker:
                 self._data.wait_for(lambda: len(t.log) > offset,
                                     timeout=timeout)
             return t.log[offset:offset + max_records]
+
+    def commit(self, topic: str, offset: int) -> None:
+        """Advance a consumer watermark (arms the `max_lag` ingress
+        bound for `topic`). Monotonic; unknown topics raise."""
+        with self._lock:
+            if topic not in self._topics:
+                raise BrokerError(f"unknown topic {topic!r}")
+            cur = self._commits.get(topic, 0)
+            self._commits[topic] = max(cur, int(offset))
 
     def end_offset(self, topic: str) -> int:
         with self._lock:

@@ -1,0 +1,437 @@
+"""kme-chaos: deterministic fault-injection runs with byte-exact verify.
+
+The recovery stack (kme-supervise -> checkpoint/resume -> at-least-once
+replay) is only trustworthy if something attacks it on purpose. This
+harness is that something: it runs a seeded workload through a
+supervised kme-serve while a KME_FAULTS schedule (kme_tpu/faults.py)
+injects broker I/O errors, partial TCP frames, torn and bit-flipped
+snapshots, torn journal tails, SIGKILLs at exact input offsets and
+stuck serve loops — then requires the COMPLETED MatchOut stream to be
+byte-exact against an in-process oracle replay of the same input,
+modulo the at-least-once duplication the recovery contract explicitly
+permits (crash -> resume from snapshot -> replay of the input tail).
+
+Everything is deterministic from --seed: the workload
+(kme_tpu.workload.harness_stream) and every fault rule's RNG derive
+from it, so a failing run reproduces from its report's spec string.
+
+The run:
+
+1. compute the oracle's expected per-message output groups in-process;
+2. start `kme-supervise -- kme-serve ...` with KME_FAULTS +
+   KME_FAULTS_STATE in its environment (the state dir makes n-limited
+   rules fire once across ALL child incarnations);
+3. produce the input over the TCP broker protocol, idempotently:
+   transport faults reconnect + resync from end_offset(MatchIn), and
+   wire-level rej_overload (the bounded-ingress shed) backs off and
+   retries — input content is never duplicated or dropped;
+4. wait for the supervisor to exit (the child exits cleanly once the
+   input is drained and --idle-exit lapses);
+5. read the durable MatchOut topic log post-mortem and verify it is a
+   prefix+replay composition of the oracle groups (verify_stream);
+6. emit a JSON report: verification result, restarts, replayed
+   messages, per-fault fire counts, measured recovery times.
+
+Exit 0 iff the stream verifies, the supervisor exited cleanly and at
+least --min-restarts automatic restarts happened (a chaos run where
+nothing died proves nothing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from kme_tpu.bridge.service import TOPIC_IN, TOPIC_OUT
+
+
+def default_schedule(seed: int, events: int, journal: bool) -> str:
+    """A schedule touching every layer: transport, snapshot integrity,
+    journal tail, process death and a hung loop. Offsets scale with the
+    workload so the kill lands mid-stream and the stall near the end."""
+    kill_at = max(1, events // 2)
+    stuck_at = max(2, (events * 3) // 4)
+    clauses = [f"seed={seed}",
+               "broker.fetch:n=2",          # service poll errors (retried)
+               "broker.produce:n=1:after=20",   # producer-side I/O error
+               "tcp.partial:n=1:after=10",  # poisoned client stream
+               "ckpt.torn:n=1:after=1",     # 2nd snapshot truncated
+               "ckpt.bitflip:n=1:after=2",  # 3rd snapshot corrupted
+               f"serve.kill:at={kill_at}",  # SIGKILL mid-stream
+               f"serve.stuck:at={stuck_at}"]  # hung step() near the end
+    if journal:
+        clauses.append("journal.torn:n=1:after=5")  # crash mid-append
+    return ";".join(clauses)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def expected_groups(lines: List[str], slots: int,
+                    max_fills: int) -> List[List[str]]:
+    """The oracle's per-input-message MatchOut line groups — the ground
+    truth the durable stream must compose from."""
+    from kme_tpu.oracle import OracleEngine
+    from kme_tpu.wire import parse_order
+
+    eng = OracleEngine("fixed", book_slots=slots, max_fills=max_fills)
+    return [[rec.wire() for rec in eng.process(parse_order(ln))]
+            for ln in lines]
+
+
+def verify_stream(got: List[str], per_msg: List[List[str]]
+                  ) -> Tuple[bool, dict]:
+    """Check `got` (the durable MatchOut lines) against the oracle
+    groups under the at-least-once contract: the stream must be a
+    concatenation of segments, each a run of consecutive whole groups,
+    where a segment may end mid-group (crash between produces) and the
+    next segment restarts at an EARLIER group (replay from a snapshot).
+    Every group must eventually complete in order. Returns
+    (ok, {messages, replays, replayed_messages, got_lines,
+    expected_lines, error})."""
+    i = j = 0               # i: cursor in got, j: next group to complete
+    replays = replayed = 0
+    detail: dict = {"got_lines": len(got),
+                    "expected_lines": sum(len(g) for g in per_msg),
+                    "messages": len(per_msg)}
+    while i < len(got) or j < len(per_msg):
+        exp = per_msg[j] if j < len(per_msg) else None
+        if exp is not None and got[i:i + len(exp)] == exp \
+                and i + len(exp) <= len(got):
+            i += len(exp)
+            j += 1
+            continue
+        # mismatch, short tail, or all groups done with got remaining:
+        # this must be a crash point. Consume any partial prefix of the
+        # current group (the child died between produces of one batch)…
+        p = 0
+        if exp is not None:
+            while (p < len(exp) and i + p < len(got)
+                   and got[i + p] == exp[p]):
+                p += 1
+        i += p
+        if i >= len(got):
+            if j < len(per_msg):
+                detail["error"] = (f"stream ends early: group {j} of "
+                                   f"{len(per_msg)} incomplete")
+                return False, detail
+            break
+        # …then the next durable line must start a REPLAY: a run that
+        # begins at some group S <= j (the snapshot the child resumed
+        # from). Prefer the largest S (minimal replay).
+        found = None
+        for S in range(j, -1, -1):
+            e2 = per_msg[S] if S < len(per_msg) else None
+            if e2 and got[i:i + len(e2)] == e2:
+                found = S
+                break
+        if found is None or (found == j and p == 0):
+            detail["error"] = (f"byte divergence at line {i} "
+                               f"(group {j}): {got[i][:100]!r}")
+            return False, detail
+        replays += 1
+        replayed += sum(1 for g in per_msg[found:j] if g) + (1 if p else 0)
+        j = found
+    if j < len(per_msg):
+        detail["error"] = (f"only {j} of {len(per_msg)} groups "
+                           f"completed")
+        return False, detail
+    detail["replays"] = replays
+    detail["replayed_messages"] = replayed
+    return True, detail
+
+
+class _Producer(threading.Thread):
+    """Idempotent MatchIn feeder: re-syncs from end_offset after any
+    transport fault (so injected tcp.partial / disconnects / broker
+    errors never duplicate or drop input) and treats rej_overload as
+    backpressure (sleep + retry the SAME record)."""
+
+    def __init__(self, host: str, port: int, lines: List[str]) -> None:
+        super().__init__(daemon=True)
+        self.host, self.port, self.lines = host, port, lines
+        self.sent = 0
+        self.overload_retries = 0
+        self.reconnects = 0
+        self.stop = threading.Event()
+
+    def run(self) -> None:
+        from kme_tpu.bridge.broker import BrokerError, BrokerOverload
+        from kme_tpu.bridge.provision import provision
+        from kme_tpu.bridge.tcp import TcpBroker
+
+        client = None
+        while self.sent < len(self.lines) and not self.stop.is_set():
+            try:
+                if client is None:
+                    client = TcpBroker(self.host, self.port, timeout=10.0)
+                    provision(client)   # idempotent
+                    self.sent = client.end_offset(TOPIC_IN)
+                client.produce(TOPIC_IN, None, self.lines[self.sent])
+                self.sent += 1
+            except BrokerOverload:
+                self.overload_retries += 1
+                time.sleep(0.05)
+            except (BrokerError, OSError):
+                # transport fault or the child is restarting: reconnect
+                # and resync the resume point from the durable log
+                if client is not None:
+                    try:
+                        client.close()
+                    except OSError:
+                        pass
+                client = None
+                self.reconnects += 1
+                time.sleep(0.2)
+        if client is not None:
+            try:
+                client.close()
+            except OSError:
+                pass
+
+
+def read_matchout(log_dir: str) -> List[str]:
+    """Post-mortem read of the durable MatchOut topic log (the broker
+    persists topics as JSONL under the checkpoint dir)."""
+    from kme_tpu.bridge.broker import BrokerError, InProcessBroker
+
+    broker = InProcessBroker(persist_dir=log_dir)
+    out: List[str] = []
+    try:
+        while True:
+            recs = broker.fetch(TOPIC_OUT, len(out), 4096, timeout=0.0)
+            if not recs:
+                return out
+            out.extend(f"{r.key} {r.value}" for r in recs)
+    except BrokerError:
+        return out          # topic never created (nothing got through)
+    finally:
+        if hasattr(broker, "close"):
+            broker.close()
+
+
+def _fault_fires(state_dir: str) -> dict:
+    fires = {}
+    try:
+        for name in sorted(os.listdir(state_dir)):
+            if name.endswith(".fired"):
+                with open(os.path.join(state_dir, name)) as f:
+                    fires[name[:-len(".fired")]] = int(f.read().strip()
+                                                       or 0)
+    except (OSError, ValueError):
+        pass
+    return fires
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kme-chaos", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--seed", type=int, default=0,
+                   help="seeds the workload AND every fault rule")
+    p.add_argument("--events", type=int, default=2000)
+    p.add_argument("--accounts", type=int, default=10)
+    p.add_argument("--symbols", type=int, default=3)
+    p.add_argument("--engine", choices=("oracle", "native", "seq",
+                                        "lanes"), default="oracle",
+                   help="serving engine under attack (oracle is host-"
+                        "only and fast on CPU; the recovery machinery "
+                        "under test is engine-independent)")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--slots", type=int, default=128)
+    p.add_argument("--max-fills", type=int, default=32)
+    p.add_argument("--checkpoint-every", type=int, default=60)
+    p.add_argument("--checkpoint-keep", type=int, default=3)
+    p.add_argument("--schedule", default=None, metavar="SPEC",
+                   help="KME_FAULTS spec (default: a seed-derived "
+                        "schedule covering transport, snapshot, "
+                        "journal, kill and stall faults)")
+    p.add_argument("--dir", default=None, metavar="DIR",
+                   help="run directory (checkpoints, broker logs, "
+                        "journal, report); default: a temp dir, kept "
+                        "on failure")
+    p.add_argument("--max-lag", type=int, default=None,
+                   help="bounded-ingress backlog bound passed to "
+                        "kme-serve (producer treats rej_overload as "
+                        "backpressure)")
+    p.add_argument("--max-restarts", type=int, default=10)
+    p.add_argument("--min-restarts", type=int, default=1,
+                   help="fail unless at least this many automatic "
+                        "restarts happened (a chaos run where nothing "
+                        "died proves nothing)")
+    p.add_argument("--stale-after", type=float, default=5.0)
+    p.add_argument("--stall-after", type=float, default=2.5)
+    p.add_argument("--grace", type=float, default=30.0)
+    p.add_argument("--idle-exit", type=float, default=5.0)
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="overall wall-clock budget for the supervised "
+                        "run")
+    p.add_argument("--no-journal", action="store_true",
+                   help="skip the flight recorder (and the journal.torn "
+                        "fault)")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="write the JSON report here (default: "
+                        "<dir>/chaos-report.json)")
+    args = p.parse_args(argv)
+
+    from kme_tpu.wire import dumps_order
+    from kme_tpu.workload import harness_stream
+
+    run_dir = args.dir
+    if run_dir is None:
+        import tempfile
+
+        run_dir = tempfile.mkdtemp(prefix="kme-chaos-")
+    os.makedirs(run_dir, exist_ok=True)
+    ckpt_dir = os.path.join(run_dir, "state")
+    state_dir = os.path.join(run_dir, "fault-state")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    journal = (None if args.no_journal
+               else os.path.join(run_dir, "journal.jsonl"))
+    schedule = args.schedule
+    if schedule is None:
+        schedule = default_schedule(args.seed, args.events,
+                                    journal is not None)
+    report_path = args.report or os.path.join(run_dir,
+                                              "chaos-report.json")
+
+    print(f"kme-chaos: seed={args.seed} events={args.events} "
+          f"engine={args.engine}\nkme-chaos: schedule {schedule}\n"
+          f"kme-chaos: run dir {run_dir}", file=sys.stderr)
+
+    # 1. the ground truth (in-process; no faults are active here)
+    msgs = harness_stream(args.events, seed=args.seed,
+                          num_accounts=args.accounts,
+                          num_symbols=args.symbols,
+                          payout_opcode_bug=False, validate=True)
+    lines = [dumps_order(m) for m in msgs]
+    per_msg = expected_groups(lines, args.slots, args.max_fills)
+
+    # 2. the supervised service under attack
+    port = _free_port()
+    serve_args = ["--engine", args.engine, "--compat", "fixed",
+                  "--batch", str(args.batch),
+                  "--slots", str(args.slots),
+                  "--max-fills", str(args.max_fills),
+                  "--checkpoint-every", str(args.checkpoint_every),
+                  "--checkpoint-keep", str(args.checkpoint_keep),
+                  "--listen", f"127.0.0.1:{port}",
+                  "--idle-exit", str(args.idle_exit),
+                  "--health-every", "0.2"]
+    if args.max_lag is not None:
+        serve_args += ["--max-lag", str(args.max_lag)]
+    if journal is not None:
+        serve_args += ["--journal-out", journal]
+    sup_cmd = [sys.executable, "-m", "kme_tpu.cli", "supervise",
+               "--checkpoint-dir", ckpt_dir,
+               "--stale-after", str(args.stale_after),
+               "--stall-after", str(args.stall_after),
+               "--max-restarts", str(args.max_restarts),
+               "--grace", str(args.grace),
+               "--backoff-base", "0.05", "--backoff-cap", "0.5",
+               "--"] + serve_args
+    env = dict(os.environ)
+    env["KME_FAULTS"] = schedule
+    env["KME_FAULTS_STATE"] = state_dir
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.time()
+    sup = subprocess.Popen(sup_cmd, env=env)
+
+    # 3. feed the input (idempotent; concurrent with the attack)
+    producer = _Producer("127.0.0.1", port, lines)
+    producer.start()
+
+    # 4. wait for the run to finish
+    sup_rc: Optional[int] = None
+    deadline = t0 + args.timeout
+    while time.time() < deadline:
+        sup_rc = sup.poll()
+        if sup_rc is not None:
+            break
+        time.sleep(0.25)
+    if sup_rc is None:
+        print(f"kme-chaos: TIMEOUT after {args.timeout}s; killing the "
+              f"supervisor", file=sys.stderr)
+        sup.kill()
+        sup.wait()
+    producer.stop.set()
+    producer.join(timeout=10.0)
+    elapsed = time.time() - t0
+
+    # 5. post-mortem verification against the oracle
+    got = read_matchout(os.path.join(ckpt_dir, "broker-log"))
+    ok, verify = verify_stream(got, per_msg)
+
+    sup_state = {}
+    try:
+        with open(os.path.join(ckpt_dir, "supervisor.json")) as f:
+            sup_state = json.load(f)
+    except (OSError, ValueError):
+        pass
+    restarts = int(sup_state.get("restarts_total", 0))
+    recoveries = sup_state.get("recoveries", [])
+    rec_times = [r["recovered_in"] for r in recoveries
+                 if "recovered_in" in r]
+
+    failures = []
+    if sup_rc != 0:
+        failures.append(f"supervisor exited rc={sup_rc}")
+    if not ok:
+        failures.append(f"stream verification failed: "
+                        f"{verify.get('error')}")
+    if producer.sent < len(lines):
+        failures.append(f"producer only delivered {producer.sent} of "
+                        f"{len(lines)} records")
+    if restarts < args.min_restarts:
+        failures.append(f"only {restarts} automatic restart(s); "
+                        f"need >= {args.min_restarts}")
+
+    report = {
+        "ok": not failures,
+        "failures": failures,
+        "seed": args.seed,
+        "events": args.events,
+        "engine": args.engine,
+        "schedule": schedule,
+        "elapsed_seconds": round(elapsed, 3),
+        "verify": verify,
+        "restarts_total": restarts,
+        "recovery_seconds": rec_times,
+        "recovery_seconds_max": max(rec_times) if rec_times else None,
+        "supervisor": sup_state,
+        "fault_fires": _fault_fires(state_dir),
+        "producer": {"sent": producer.sent,
+                     "overload_retries": producer.overload_retries,
+                     "reconnects": producer.reconnects},
+        "run_dir": run_dir,
+    }
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1)
+    status = "OK" if report["ok"] else "FAILED"
+    print(f"kme-chaos: {status} — {len(got)} MatchOut lines verified "
+          f"against {len(per_msg)} oracle groups "
+          f"(replays={verify.get('replays', '?')}, replayed_messages="
+          f"{verify.get('replayed_messages', '?')}), "
+          f"restarts={restarts}, "
+          f"recovery={rec_times and max(rec_times) or 'n/a'}s, "
+          f"elapsed={elapsed:.1f}s", file=sys.stderr)
+    for fail in failures:
+        print(f"kme-chaos: FAIL: {fail}", file=sys.stderr)
+    print(f"kme-chaos: report written to {report_path}", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,18 @@ def main(argv=None) -> int:
                         "snapshot at startup (at-least-once replay)")
     p.add_argument("--checkpoint-every", type=int, default=4096,
                    metavar="N", help="records between snapshots")
+    p.add_argument("--checkpoint-keep", type=int, default=None,
+                   metavar="N",
+                   help="snapshots retained per kind (default 3, or "
+                        "KME_CKPT_KEEP); deeper retention survives "
+                        "multi-snapshot corruption (load falls back "
+                        "newest -> older on digest/parse failure)")
+    p.add_argument("--max-lag", type=int, default=None, metavar="N",
+                   help="bounded ingress: reject produces to MatchIn "
+                        "with a wire-level rej_overload once the "
+                        "unconsumed backlog reaches N records (shed "
+                        "load instead of stalling); in-process broker "
+                        "only")
     p.add_argument("--log-dir", default=None, metavar="DIR",
                    help="persist topic logs here (append-only JSONL) so "
                         "the broker survives restarts; defaults to "
@@ -124,7 +136,8 @@ def main(argv=None) -> int:
         log_dir = args.log_dir
         if log_dir is None and args.checkpoint_dir is not None:
             log_dir = os.path.join(args.checkpoint_dir, "broker-log")
-        broker = InProcessBroker(persist_dir=log_dir)
+        broker = InProcessBroker(persist_dir=log_dir,
+                                 max_lag=args.max_lag)
         host, port = parse_addr(args.listen)
         srv, broker = serve_broker(host, port, broker)
         real_host, real_port = srv.server_address[:2]
@@ -145,6 +158,7 @@ def main(argv=None) -> int:
                        shards=args.shards, strict=args.strict,
                        checkpoint_dir=args.checkpoint_dir,
                        checkpoint_every=args.checkpoint_every,
+                       checkpoint_keep=args.checkpoint_keep,
                        journal=args.journal_out,
                        journal_rotate_mb=args.journal_rotate_mb,
                        journal_fsync=args.journal_fsync,

@@ -28,6 +28,8 @@ from __future__ import annotations
 import sys
 from typing import Optional
 
+from kme_tpu import faults
+
 TOPIC_IN = "MatchIn"    # topic.js:17
 TOPIC_OUT = "MatchOut"  # topic.js:21
 
@@ -41,6 +43,7 @@ class MatchService:
                  strict: bool = False,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 4096,
+                 checkpoint_keep: Optional[int] = None,
                  journal=None, journal_rotate_mb: Optional[int] = None,
                  journal_fsync: str = "off",
                  audit: bool = False,
@@ -66,6 +69,7 @@ class MatchService:
         self._session = self._oracle = self._native = None
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
+        self.checkpoint_keep = checkpoint_keep
         self._last_ckpt_offset = 0
         self._req_symbols, self._req_accounts = symbols, accounts
         self._req_slots, self._req_max_fills = slots, max_fills
@@ -83,6 +87,7 @@ class MatchService:
         if resumed:
             self._init_telemetry()
             self._init_observability(resumed=True)
+            self._commit_watermark()
             return
         if engine == "lanes":
             from kme_tpu.engine.lanes import LaneConfig
@@ -111,6 +116,22 @@ class MatchService:
             raise ValueError(f"unknown engine {engine!r}")
         self._init_telemetry()
         self._init_observability(resumed=False)
+        self._commit_watermark()
+
+    def _commit_watermark(self) -> None:
+        """Advance the broker's consumer watermark for MatchIn — this
+        arms (and continuously re-arms) the bounded-ingress max_lag
+        check: producers past the bound get a wire-level rej_overload
+        (BrokerOverload) instead of growing the backlog unboundedly."""
+        commit = getattr(self.broker, "commit", None)
+        if commit is None:
+            return
+        from kme_tpu.bridge.broker import BrokerError
+
+        try:
+            commit(TOPIC_IN, self.offset)
+        except BrokerError:
+            pass        # topic not provisioned yet / transport blip
 
     def _init_observability(self, resumed: bool) -> None:
         """Flight recorder + invariant auditor wiring. The journal
@@ -183,11 +204,31 @@ class MatchService:
         """The service's metrics surface (/metrics, heartbeat). Session
         engines already own a Registry — share it so engine counters,
         histograms and service counters expose through ONE endpoint;
-        host-only engines (native/oracle) get a service-local one."""
+        host-only engines (native/oracle) get a service-local one.
+
+        Supervision provenance rides in via environment: kme-supervise
+        stamps each incarnation with its restart ordinal and the wall
+        time of the failure it is recovering from, so restarts_total
+        and recovery_seconds surface on THIS process's /metrics."""
+        import os
+        import time
+
         from kme_tpu.telemetry import Registry
 
         self.telemetry = (self._session.telemetry
                           if self._session is not None else Registry())
+        try:
+            ordinal = int(os.environ.get("KME_RESTART_ORDINAL", "0"))
+        except ValueError:
+            ordinal = 0
+        self.telemetry.gauge("restarts_total").set(ordinal)
+        failed_at = os.environ.get("KME_FAILED_AT")
+        if failed_at:
+            try:
+                self.telemetry.gauge("recovery_seconds").set(
+                    round(max(0.0, time.time() - float(failed_at)), 3))
+            except ValueError:
+                pass
 
     # ------------------------------------------------------------------
     # durability: snapshot at batch boundaries, resume = load + replay
@@ -322,14 +363,16 @@ class MatchService:
 
             if isinstance(self._session, SeqSession):
                 ck.save_seq_session(self.checkpoint_dir, self._session,
-                                    self.offset)
+                                    self.offset, keep=self.checkpoint_keep)
             else:
                 ck.save_session(self.checkpoint_dir, self._session,
-                                self.offset)
+                                self.offset, keep=self.checkpoint_keep)
         elif self._native is not None:
-            ck.save_native(self.checkpoint_dir, self._native, self.offset)
+            ck.save_native(self.checkpoint_dir, self._native, self.offset,
+                           keep=self.checkpoint_keep)
         else:
-            ck.save_oracle(self.checkpoint_dir, self._oracle, self.offset)
+            ck.save_oracle(self.checkpoint_dir, self._oracle, self.offset,
+                           keep=self.checkpoint_keep)
         self._last_ckpt_offset = self.offset
         if self.journal is not None:
             # the journal is best-effort relative to the broker log, but
@@ -431,7 +474,12 @@ class MatchService:
         # batch-boundary commit (H5): offsets advance only after the
         # outputs for the whole batch are on MatchOut
         self.offset = recs[-1].offset + 1
+        # crash window the chaos harness targets: outputs are on
+        # MatchOut but the snapshot has not caught up — recovery MUST
+        # replay from the last checkpoint and reproduce these bytes
+        faults.kill_now("serve.kill", offset=self.offset)
         self._maybe_checkpoint()
+        self._commit_watermark()
         self._publish_batch(len(recs), len(recs) - len(msgs))
         return len(recs)
 
@@ -447,17 +495,49 @@ class MatchService:
         t.counter("service_records").inc(nrecs)
         t.counter("service_dropped").inc(ndropped)
         t.gauge("service_offset").set(self.offset)
+        if faults.active():
+            t.gauge("faults_injected").set(faults.fired_total())
+        shed = getattr(self.broker, "overload_rejects", None)
+        if shed is not None:
+            t.gauge("overload_rejects").set(shed)
         now = time.monotonic()
         if self._session is not None and now - self._last_engine_pub >= 1.0:
             self._last_engine_pub = now
             self._session.metrics()      # publishes counters + gauges
             self._session.histograms()   # publishes bucket counts
 
+    def _produce_retry(self, topic: str, key, value) -> None:
+        """Produce with bounded exponential backoff. A transport blip
+        (socket reset, injected broker.produce fault) must not kill the
+        serve loop mid-batch: the offset has NOT advanced yet, so a
+        retry is safe — at worst the record lands twice, which the
+        at-least-once contract already allows. Gives up (re-raises)
+        after the attempts are exhausted so a genuinely dead broker
+        still fails loudly for the supervisor."""
+        import time
+
+        from kme_tpu.bridge.broker import BrokerError
+
+        delay = 0.05
+        for attempt in range(6):
+            try:
+                self.broker.produce(topic, key, value)
+                return
+            except BrokerError as e:
+                if attempt == 5:
+                    raise
+                self.telemetry.counter("broker_retries").inc()
+                print(f"kme-serve: produce to {topic} failed ({e}); "
+                      f"retry {attempt + 1}/5 in {delay:.2f}s",
+                      file=sys.stderr)
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
     def _produce_lines(self, out) -> None:
         for lines in out:
             for ln in lines:
                 key, _, value = ln.partition(" ")
-                self.broker.produce(TOPIC_OUT, key, value)
+                self._produce_retry(TOPIC_OUT, key, value)
 
     def _native_produce(self, msgs):
         # byte-faithful death handling: forward every completed
@@ -486,7 +566,7 @@ class MatchService:
                     else reason_for_reject(m["action"]))
             if code == 0:
                 code = REJ_UNSPECIFIED
-            self.broker.produce(TOPIC_OUT, "REJ", rej_record_json(
+            self._produce_retry(TOPIC_OUT, "REJ", rej_record_json(
                 m["oid"], m["aid"], code))
 
     def _degrade_to_native(self, reason: str) -> None:
@@ -588,6 +668,15 @@ class MatchService:
                         and not os.path.exists(stall_once)):
                     open(stall_once, "w").close()
                     while True:   # frozen tick, live heartbeat thread
+                        time.sleep(0.5)
+                if n and faults.should("serve.stuck", offset=self.offset):
+                    # stuck step(): the loop tick freezes while the
+                    # heartbeat thread keeps the mtime fresh — exactly
+                    # the hang shape the supervisor's stall branch
+                    # detects (fresh mtime + frozen tick)
+                    print(f"kme-faults: serve loop stuck at offset "
+                          f"{self.offset}", file=sys.stderr)
+                    while True:
                         time.sleep(0.5)
         finally:
             if beat_stop is not None:

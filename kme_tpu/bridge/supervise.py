@@ -15,123 +15,279 @@ and restarts the child from its newest checkpoint whenever
   tick means a hang inside step() — e.g. a stuck device call — even
   while the heartbeat thread keeps the mtime fresh).
 
+Restart policy (the production-shaped part):
+
+- restarts are paced by JITTERED EXPONENTIAL BACKOFF keyed on the
+  failure FINGERPRINT (exit:<rc> / stale / stall): a crash LOOP — the
+  same fingerprint repeating — doubles the delay each round up to
+  --backoff-cap, while a novel failure resets to --backoff-base, so a
+  one-off blip restarts fast and a deterministic crash does not spin.
+- the restart BUDGET (--max-restarts) counts failures but DECAYS: each
+  --healthy-decay seconds of continuous healthy child uptime refunds
+  one unit. A service that crashes once a day never exhausts a budget
+  of 5; only a crash loop does. (`restarts_total` stays lifetime for
+  reporting — only the budget decays.)
+- each incarnation is stamped via environment: KME_RESTART_ORDINAL
+  (lifetime restart count) and KME_FAILED_AT (wall time the failure
+  was detected), which the child surfaces as the restarts_total and
+  recovery_seconds telemetry gauges.
+- supervisor state (restarts, budget, fingerprints, per-recovery
+  timings) is mirrored to <checkpoint-dir>/supervisor.json after every
+  transition — the kme-chaos report reads it post-mortem.
+
 Durability is the existing checkpoint/resume contract: broker topic
 logs persist under the checkpoint dir, the child resumes from the
 newest fsync'd snapshot, and at-least-once replay of the input tail
 reproduces the byte-exact output stream
 (tests/test_supervise.py kills the child mid-stream and requires the
 completed MatchOut stream to equal the oracle's).
+
+The Supervisor class takes injectable clock / sleep / popen / mtime
+hooks so the detection and policy logic is unit-testable in
+milliseconds (tests/test_supervise_unit.py) — the defaults are the
+real OS facilities.
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
+import random
 import signal
 import subprocess
 import sys
 import time
+from typing import Optional
+
+STATE_FILE = "supervisor.json"
 
 
-def _alive(proc: subprocess.Popen) -> bool:
-    return proc.poll() is None
+class Supervisor:
+    def __init__(self, serve_args, checkpoint_dir: str,
+                 stale_after: float = 10.0, max_restarts: int = 5,
+                 grace: float = 5.0, poll: float = 0.5, echo: bool = True,
+                 stall_after: float = 300.0,
+                 backoff_base: float = 0.25, backoff_cap: float = 10.0,
+                 healthy_decay: float = 60.0,
+                 popen=None, clock=None, sleep=None, mtime=None,
+                 rng=None) -> None:
+        """serve_args: argv tail passed to `kme-serve` verbatim (the
+        supervisor adds --checkpoint-dir and --health-file itself; a
+        user-supplied occurrence of either inside serve_args would
+        silently WIN under argparse's last-occurrence rule, leaving the
+        supervisor watching a heartbeat file the child never writes —
+        so both are rejected)."""
+        reserved = ("--checkpoint-dir", "--health-file")
+        for a in serve_args:
+            flag = a.split("=", 1)[0]
+            # argparse abbreviation: any prefix of a reserved flag
+            # resolves to it in the child (allow_abbrev default), so
+            # prefixes are rejected too
+            if (flag.startswith("--") and len(flag) > 2
+                    and any(r.startswith(flag) for r in reserved)):
+                raise ValueError(
+                    f"{flag} is managed by the supervisor and cannot "
+                    f"appear in serve_args (the child must write the "
+                    f"heartbeat/checkpoints the supervisor watches)")
+        self.checkpoint_dir = checkpoint_dir
+        self.stale_after = stale_after
+        self.max_restarts = max_restarts
+        self.grace = grace
+        self.poll = poll
+        self.echo = echo
+        self.stall_after = stall_after
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.healthy_decay = healthy_decay
+        # injectable OS facilities (unit tests script these)
+        self._popen = popen or (
+            lambda cmd, env: subprocess.Popen(cmd, env=env))
+        self._clock = clock or time.time
+        self._sleep = sleep or time.sleep
+        self._mtime = mtime or (lambda p: os.stat(p).st_mtime)
+        self._rng = rng or random.Random()
+        self.hb = os.path.join(checkpoint_dir, "serve.health")
+        self.base_cmd = [sys.executable, "-m", "kme_tpu.cli", "serve",
+                         "--checkpoint-dir", checkpoint_dir,
+                         "--health-file", self.hb] + list(serve_args)
+        # policy state
+        self.restarts_total = 0      # lifetime, for reporting
+        self.budget_used = 0         # decays over healthy uptime
+        self.fingerprints: dict = {}
+        self.recoveries: list = []
+        self._last_fingerprint: Optional[str] = None
+        self._streak = 0
 
+    # -- small injectable-friendly primitives --------------------------
 
-def _hb_age(path: str) -> float:
-    try:
-        return time.time() - os.stat(path).st_mtime
-    except OSError:
-        return float("inf")
+    def _say(self, msg: str) -> None:
+        if self.echo:
+            print(f"kme-supervise: {msg}", file=sys.stderr)
 
+    def _hb_age(self) -> float:
+        try:
+            return self._clock() - self._mtime(self.hb)
+        except OSError:
+            return float("inf")
 
-def _hb_tick(path: str):
-    try:
-        with open(path) as f:
-            return json.load(f).get("tick")
-    except (OSError, ValueError):
-        return None
+    def _hb_tick(self):
+        try:
+            with open(self.hb) as f:
+                return json.load(f).get("tick")
+        except (OSError, ValueError):
+            return None
+
+    def _write_state(self) -> None:
+        """Mirror policy state to <checkpoint-dir>/supervisor.json
+        (atomic replace) — the chaos report reads it post-mortem."""
+        path = os.path.join(self.checkpoint_dir, STATE_FILE)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"restarts_total": self.restarts_total,
+                           "budget_used": self.budget_used,
+                           "max_restarts": self.max_restarts,
+                           "fingerprints": self.fingerprints,
+                           "recoveries": self.recoveries}, f, indent=1)
+            os.replace(tmp, path)
+        except OSError:
+            pass    # reporting surface only; never kill supervision
+
+    def _backoff(self) -> float:
+        """Jittered exponential delay keyed on the fingerprint streak:
+        1st occurrence waits ~base, each repeat doubles up to cap, and
+        the 0.5–1.5x jitter decorrelates a fleet restarting off the
+        same shared-dependency failure."""
+        delay = min(self.backoff_cap,
+                    self.backoff_base * (2 ** max(0, self._streak - 1)))
+        return delay * (0.5 + self._rng.random())
+
+    def _note_failure(self, fingerprint: str) -> None:
+        self.restarts_total += 1
+        self.budget_used += 1
+        self.fingerprints[fingerprint] = \
+            self.fingerprints.get(fingerprint, 0) + 1
+        if fingerprint == self._last_fingerprint:
+            self._streak += 1
+        else:
+            self._last_fingerprint, self._streak = fingerprint, 1
+        self._write_state()
+
+    # -- the supervision loop ------------------------------------------
+
+    def run(self) -> int:
+        """Run kme-serve under supervision; returns the child's final
+        rc (0 = clean exit, 1 = restart budget exhausted)."""
+        failed_at: Optional[float] = None    # wall time of last failure
+        while True:
+            with contextlib.suppress(OSError):
+                os.unlink(self.hb)
+            self._say(f"starting kme-serve (restart "
+                      f"{self.budget_used}/{self.max_restarts})")
+            env = dict(os.environ)
+            env["KME_RESTART_ORDINAL"] = str(self.restarts_total)
+            if failed_at is not None:
+                env["KME_FAILED_AT"] = repr(failed_at)
+            else:
+                env.pop("KME_FAILED_AT", None)
+            child = self._popen(self.base_cmd, env)
+            start = self._clock()
+            failed = fingerprint = None
+            recovering = failed_at    # measure to the first heartbeat
+            # stall detection ARMS only once the loop has ticked at
+            # least once: a first batch can legitimately sit in an
+            # XLA/Pallas compile for minutes before the first step()
+            # returns, and killing it mid-compile would loop forever
+            last_tick, tick_since, armed = None, self._clock(), False
+            last_decay = self._clock()
+            while True:
+                self._sleep(self.poll)
+                now = self._clock()
+                # healthy-uptime budget decay: each healthy_decay
+                # seconds of continuous uptime refunds one budget unit
+                # (a crash LOOP never stays up long enough to refund)
+                if (self.budget_used > 0
+                        and now - last_decay >= self.healthy_decay):
+                    last_decay = now
+                    self.budget_used -= 1
+                    self._say(f"healthy for {self.healthy_decay:.0f}s; "
+                              f"restart budget refunded "
+                              f"({self.budget_used}/{self.max_restarts} "
+                              f"used)")
+                    self._write_state()
+                if child.poll() is not None:
+                    rc = child.returncode
+                    if rc == 0:
+                        self._say("child exited cleanly")
+                        self._write_state()
+                        return 0
+                    failed = f"child exited rc={rc}"
+                    fingerprint = f"exit:{rc}"
+                    break
+                age = self._hb_age()
+                if age == float("inf"):
+                    # allow a startup grace window before the first
+                    # heartbeat is due
+                    if now - start < self.grace:
+                        continue
+                    failed = (f"no heartbeat within grace "
+                              f"({self.grace}s)")
+                    fingerprint = "stale"
+                    break
+                if recovering is not None:
+                    # first heartbeat of a restarted incarnation: the
+                    # service is serving again — close the recovery
+                    # window opened at failure detection
+                    took = now - recovering
+                    self.recoveries.append(
+                        {"fingerprint": self._last_fingerprint,
+                         "detected_at": recovering,
+                         "recovered_in": round(took, 3)})
+                    self._say(f"recovered in {took:.2f}s")
+                    recovering = None
+                    self._write_state()
+                if age > self.stale_after:
+                    failed = (f"heartbeat stale ({age:.1f}s > "
+                              f"{self.stale_after}s)")
+                    fingerprint = "stale"
+                    break
+                tick = self._hb_tick()
+                if tick != last_tick:
+                    if last_tick is not None:
+                        armed = True
+                    last_tick, tick_since = tick, now
+                elif armed and now - tick_since > self.stall_after:
+                    failed = (f"serve loop stalled (tick {tick} frozen "
+                              f"{now - tick_since:.0f}s)")
+                    fingerprint = "stall"
+                    break
+            failed_at = self._clock()
+            self._say(f"FAILURE DETECTED: {failed}")
+            if child.poll() is None:
+                child.send_signal(signal.SIGKILL)
+                child.wait()
+            self._note_failure(fingerprint)
+            if self.budget_used > self.max_restarts:
+                self._say("restart budget exhausted")
+                return 1
+            delay = self._backoff()
+            if delay > 0:
+                self._say(f"backing off {delay:.2f}s "
+                          f"(failure streak {self._streak} "
+                          f"x {self._last_fingerprint})")
+                self._sleep(delay)
 
 
 def supervise(serve_args, checkpoint_dir: str, stale_after: float = 10.0,
               max_restarts: int = 5, grace: float = 5.0,
               poll: float = 0.5, echo: bool = True,
-              stall_after: float = 300.0) -> int:
-    """Run kme-serve under supervision; returns the child's final rc.
-
-    serve_args: argv tail passed to `kme-serve` verbatim (the supervisor
-    adds --checkpoint-dir and --health-file itself; a user-supplied
-    occurrence of either inside serve_args would silently WIN under
-    argparse's last-occurrence rule, leaving the supervisor watching a
-    heartbeat file the child never writes — so both are rejected)."""
-    reserved = ("--checkpoint-dir", "--health-file")
-    for a in serve_args:
-        flag = a.split("=", 1)[0]
-        # argparse abbreviation: any prefix of a reserved flag resolves
-        # to it in the child (allow_abbrev default), so prefixes are
-        # rejected too
-        if (flag.startswith("--") and len(flag) > 2
-                and any(r.startswith(flag) for r in reserved)):
-            raise ValueError(
-                f"{flag} is managed by the supervisor and cannot appear "
-                f"in serve_args (the child must write the heartbeat/"
-                f"checkpoints the supervisor watches)")
-    hb = os.path.join(checkpoint_dir, "serve.health")
-    base = [sys.executable, "-m", "kme_tpu.cli", "serve",
-            "--checkpoint-dir", checkpoint_dir,
-            "--health-file", hb] + list(serve_args)
-    restarts = 0
-    while True:
-        if os.path.exists(hb):
-            os.unlink(hb)
-        if echo:
-            print(f"kme-supervise: starting kme-serve "
-                  f"(restart {restarts}/{max_restarts})", file=sys.stderr)
-        child = subprocess.Popen(base)
-        start = time.time()
-        failed = None
-        # stall detection ARMS only once the loop has ticked at least
-        # once: a first batch can legitimately sit in an XLA/Pallas
-        # compile for minutes before the first step() returns, and
-        # killing it mid-compile would loop forever
-        last_tick, tick_since, armed = None, time.time(), False
-        while True:
-            time.sleep(poll)
-            if not _alive(child):
-                rc = child.returncode
-                if rc == 0:
-                    if echo:
-                        print("kme-supervise: child exited cleanly",
-                              file=sys.stderr)
-                    return 0
-                failed = f"child exited rc={rc}"
-                break
-            age = _hb_age(hb)
-            # allow a startup grace window before the first heartbeat
-            if age == float("inf") and time.time() - start < grace:
-                continue
-            if age > stale_after:
-                failed = f"heartbeat stale ({age:.1f}s > {stale_after}s)"
-                break
-            tick = _hb_tick(hb)
-            if tick != last_tick:
-                if last_tick is not None:
-                    armed = True
-                last_tick, tick_since = tick, time.time()
-            elif armed and time.time() - tick_since > stall_after:
-                failed = (f"serve loop stalled (tick {tick} frozen "
-                          f"{time.time() - tick_since:.0f}s)")
-                break
-        if echo:
-            print(f"kme-supervise: FAILURE DETECTED: {failed}",
-                  file=sys.stderr)
-        if _alive(child):
-            child.send_signal(signal.SIGKILL)
-            child.wait()
-        restarts += 1
-        if restarts > max_restarts:
-            print("kme-supervise: restart budget exhausted", file=sys.stderr)
-            return 1
+              stall_after: float = 300.0, **kw) -> int:
+    """Functional wrapper over Supervisor (the original API)."""
+    return Supervisor(serve_args, checkpoint_dir, stale_after=stale_after,
+                      max_restarts=max_restarts, grace=grace, poll=poll,
+                      echo=echo, stall_after=stall_after, **kw).run()
 
 
 def main(argv=None) -> int:
@@ -146,9 +302,20 @@ def main(argv=None) -> int:
     p.add_argument("--stall-after", type=float, default=300.0,
                    help="seconds without a loop-tick advance that count "
                         "as a hang inside step()")
-    p.add_argument("--max-restarts", type=int, default=5)
+    p.add_argument("--max-restarts", type=int, default=5,
+                   help="restart budget; refunded by healthy uptime "
+                        "(--healthy-decay), so only a crash LOOP "
+                        "exhausts it")
     p.add_argument("--grace", type=float, default=5.0,
                    help="startup seconds before the first heartbeat is due")
+    p.add_argument("--backoff-base", type=float, default=0.25,
+                   help="restart delay for a first/novel failure; "
+                        "repeats of the same failure fingerprint double "
+                        "it up to --backoff-cap (with 0.5-1.5x jitter)")
+    p.add_argument("--backoff-cap", type=float, default=10.0)
+    p.add_argument("--healthy-decay", type=float, default=60.0,
+                   help="seconds of continuous healthy uptime that "
+                        "refund one restart-budget unit")
     p.add_argument("serve_args", nargs=argparse.REMAINDER,
                    help="arguments after '--' go to kme-serve verbatim")
     args = p.parse_args(argv)
@@ -160,7 +327,10 @@ def main(argv=None) -> int:
         return supervise(serve_args, args.checkpoint_dir,
                          stale_after=args.stale_after,
                          max_restarts=args.max_restarts, grace=args.grace,
-                         stall_after=args.stall_after)
+                         stall_after=args.stall_after,
+                         backoff_base=args.backoff_base,
+                         backoff_cap=args.backoff_cap,
+                         healthy_decay=args.healthy_decay)
     except ValueError as e:
         print(f"kme-supervise: {e}", file=sys.stderr)
         return 2

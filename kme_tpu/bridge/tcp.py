@@ -12,10 +12,12 @@ object per line — carrying the three broker operations:
    "timeout_ms":W}                                -> {"ok":true,
                                                      "records":[[o,k,v],...]}
   {"op":"end_offset","topic":T}                   -> {"ok":true,"offset":N}
+  {"op":"commit","topic":T,"offset":N}            -> {"ok":true}
   {"op":"sync"}                                   -> {"ok":true}
 
 Errors come back as {"ok":false,"error":"..."}; the client raises
-BrokerError. `serve_broker` hosts an InProcessBroker for any number of
+BrokerError (BrokerOverload when the reply carries
+"code":"rej_overload" — the bounded-ingress shed). `serve_broker` hosts an InProcessBroker for any number of
 concurrent client connections (thread per connection — the broker core
 is already thread-safe).
 """
@@ -28,7 +30,9 @@ import socketserver
 import threading
 from typing import List, Optional
 
-from kme_tpu.bridge.broker import BrokerError, InProcessBroker, Record
+from kme_tpu import faults
+from kme_tpu.bridge.broker import (BrokerError, BrokerOverload,
+                                   InProcessBroker, Record)
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -66,18 +70,32 @@ class _Handler(socketserver.StreamRequestHandler):
                 elif op == "end_offset":
                     resp = {"ok": True,
                             "offset": broker.end_offset(req["topic"])}
+                elif op == "commit":
+                    broker.commit(req["topic"], int(req["offset"]))
+                    resp = {"ok": True}
                 elif op == "sync":
                     broker.sync()
                     resp = {"ok": True}
                 else:
                     resp = {"ok": False, "error": f"unknown op {op!r}"}
+            except BrokerOverload as e:
+                resp = {"ok": False, "error": str(e), "code": e.code}
             except BrokerError as e:
                 resp = {"ok": False, "error": str(e)}
             except (KeyError, ValueError, TypeError) as e:
                 resp = {"ok": False, "error": f"bad request: {e}"}
+            if faults.should("tcp.disconnect"):
+                return      # drop the connection without replying
+            blob = (json.dumps(resp, separators=(",", ":")) + "\n").encode()
+            if faults.should("tcp.partial"):
+                try:
+                    self.wfile.write(blob[:max(1, len(blob) // 2)])
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                return      # partial frame, then drop the connection
             try:
-                self.wfile.write(
-                    (json.dumps(resp, separators=(",", ":")) + "\n").encode())
+                self.wfile.write(blob)
             except (BrokenPipeError, ConnectionResetError):
                 return
 
@@ -162,7 +180,10 @@ class TcpBroker:
                 raise BrokerError("partial broker reply; connection closed")
         resp = json.loads(raw)
         if not resp.get("ok"):
-            raise BrokerError(resp.get("error", "unknown broker error"))
+            err = resp.get("error", "unknown broker error")
+            if resp.get("code") == BrokerOverload.code:
+                raise BrokerOverload(err)
+            raise BrokerError(err)
         return resp
 
     def create_topic(self, name: str, partitions: int = 1) -> bool:
@@ -191,6 +212,11 @@ class TcpBroker:
 
     def end_offset(self, topic: str) -> int:
         return self._call({"op": "end_offset", "topic": topic})["offset"]
+
+    def commit(self, topic: str, offset: int) -> None:
+        """Advance the consumer watermark that arms the broker's
+        bounded-ingress `max_lag` check (see InProcessBroker.commit)."""
+        self._call({"op": "commit", "topic": topic, "offset": offset})
 
     def sync(self) -> None:
         """fsync the broker's topic logs (see InProcessBroker.sync)."""

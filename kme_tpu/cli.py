@@ -49,18 +49,36 @@ def loadgen_main(argv=None) -> int:
         from kme_tpu.bridge.service import TOPIC_IN
         from kme_tpu.bridge.tcp import TcpBroker, parse_addr
 
+        import time
+
+        from kme_tpu.bridge.broker import BrokerOverload
+
         host, port = parse_addr(args.broker)
         client = TcpBroker(host, port)
+        shed = 0
         try:
             provision(client)  # idempotent: both topics must exist
-            for lo in range(0, len(msgs), 4096):
-                client.produce_batch(
-                    TOPIC_IN, [(None, dumps_order(m))
-                               for m in msgs[lo:lo + 4096]])
+            lo = 0
+            while lo < len(msgs):
+                try:
+                    client.produce_batch(
+                        TOPIC_IN, [(None, dumps_order(m))
+                                   for m in msgs[lo:lo + 4096]])
+                except BrokerOverload:
+                    # bounded ingress (kme-serve --max-lag): the broker
+                    # sheds load instead of growing the backlog — treat
+                    # as backpressure and re-offer the batch from the
+                    # broker's durable high-water mark
+                    shed += 1
+                    time.sleep(0.1)
+                    lo = client.end_offset(TOPIC_IN)
+                    continue
+                lo += 4096
         finally:
             client.close()
-        print(f"kme-loadgen: produced {len(msgs)} records to MatchIn",
-              file=sys.stderr)
+        note = f" ({shed} overload backoffs)" if shed else ""
+        print(f"kme-loadgen: produced {len(msgs)} records to MatchIn"
+              f"{note}", file=sys.stderr)
         return 0
     for m in msgs:
         print(dumps_order(m))
@@ -326,11 +344,21 @@ def supervise_main(argv=None) -> int:
     return _main(argv)
 
 
+def chaos_main(argv=None) -> int:
+    """Deterministic fault-injection runs (kme-supervise + KME_FAULTS)
+    with byte-exact MatchOut verification against the oracle."""
+    try:
+        from kme_tpu.bridge.chaos import main as _main
+    except ImportError:
+        return _not_yet("the chaos harness")
+    return _main(argv)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m kme_tpu.cli")
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
-        "supervise", "trace"))
+        "supervise", "trace", "chaos"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
@@ -338,6 +366,7 @@ def main(argv=None) -> int:
             "bench": bench_main, "serve": serve_main,
             "consume": consume_main, "provision": provision_main,
             "supervise": supervise_main, "trace": trace_main,
+            "chaos": chaos_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

@@ -27,6 +27,7 @@ recreates it as zeros.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import io
 import json
 import os
@@ -35,7 +36,21 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from kme_tpu import faults
+
 _CKPT_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+
+
+def _keep_default() -> int:
+    """Snapshot retention depth. Two is the bare minimum (newest + one
+    fallback); the default keeps a deeper tail so several consecutive
+    corrupt/torn snapshots still leave a valid restore point
+    (kme-chaos tears AND bit-flips). KME_CKPT_KEEP / --checkpoint-keep
+    override."""
+    try:
+        return max(1, int(os.environ.get("KME_CKPT_KEEP", "3")))
+    except ValueError:
+        return 3
 
 
 class SnapshotCapacityError(ValueError):
@@ -58,10 +73,31 @@ def snapshot_path(ckpt_dir: str, offset: int) -> str:
     return os.path.join(ckpt_dir, f"ckpt-{offset}.npz")
 
 
-def _atomic_savez(ckpt_dir: str, offset: int, payload: dict) -> str:
-    """THE durable snapshot write: tmp file + fsync + atomic rename +
-    directory fsync + prune. Every .npz save path goes through here so
-    the crash-safety sequence cannot fork."""
+def _payload_digest(payload: dict) -> str:
+    """sha256 over every array's dtype/shape/bytes (sorted key order,
+    'digest' excluded) — the content integrity check _load_file
+    verifies. A bit-flipped payload that still np.load-parses fails
+    HERE instead of silently restoring wrong state."""
+    h = hashlib.sha256()
+    for k in sorted(payload):
+        if k == "digest":
+            continue
+        arr = np.ascontiguousarray(np.asarray(payload[k]))
+        h.update(k.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _atomic_savez(ckpt_dir: str, offset: int, payload: dict,
+                  keep: Optional[int] = None) -> str:
+    """THE durable snapshot write: content digest + tmp file + fsync +
+    atomic rename + directory fsync + prune. Every .npz save path goes
+    through here so the crash-safety sequence cannot fork."""
+    payload = dict(payload)
+    payload["digest"] = np.frombuffer(
+        _payload_digest(payload).encode(), dtype=np.uint8)
     path = snapshot_path(ckpt_dir, offset)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -70,8 +106,17 @@ def _atomic_savez(ckpt_dir: str, offset: int, payload: dict) -> str:
         os.fsync(f.fileno())
     os.replace(tmp, path)
     _fsync_dir(ckpt_dir)
-    _prune(ckpt_dir, _CKPT_RE)
+    _post_write_faults(path)
+    _prune(ckpt_dir, _CKPT_RE, keep=keep)
     return path
+
+
+def _post_write_faults(path: str) -> None:
+    """kme-chaos injection points: tear or bit-flip the snapshot that
+    was just made durable (the load path must detect either and fall
+    back to the previous snapshot)."""
+    faults.damage_file("ckpt.torn", path)
+    faults.damage_file("ckpt.bitflip", path)
 
 
 def list_snapshots(ckpt_dir: str) -> List[Tuple[int, str]]:
@@ -87,7 +132,8 @@ def list_snapshots(ckpt_dir: str) -> List[Tuple[int, str]]:
     return out
 
 
-def save_session(ckpt_dir: str, session, offset: int) -> str:
+def save_session(ckpt_dir: str, session, offset: int,
+                 keep: Optional[int] = None) -> str:
     """Snapshot `session` (a LaneSession) at input offset `offset`.
     Must be called at a batch boundary (the fill log drained)."""
     import jax
@@ -127,7 +173,7 @@ def save_session(ckpt_dir: str, session, offset: int) -> str:
         payload[k] = v
     payload["meta"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
-    return _atomic_savez(ckpt_dir, offset, payload)
+    return _atomic_savez(ckpt_dir, offset, payload, keep=keep)
 
 
 def _fsync_dir(d: str) -> None:
@@ -138,9 +184,13 @@ def _fsync_dir(d: str) -> None:
         os.close(fd)
 
 
-def _prune(ckpt_dir: str, pattern, keep: int = 2) -> None:
-    """Unlink all but the newest `keep` snapshots (load only ever uses
-    the newest valid one plus at most one fallback)."""
+def _prune(ckpt_dir: str, pattern, keep: Optional[int] = None) -> None:
+    """Unlink all but the newest `keep` snapshots. keep=None uses the
+    configured default (_keep_default) — deep enough that multi-step
+    fallback past several corrupt snapshots still finds a valid one."""
+    if keep is None:
+        keep = _keep_default()
+    keep = max(1, int(keep))
     cands = []
     for name in os.listdir(ckpt_dir):
         m = pattern.match(name)
@@ -156,6 +206,14 @@ def _prune(ckpt_dir: str, pattern, keep: int = 2) -> None:
 
 def _load_file(path: str):
     data = np.load(path)
+    if "digest" in data.files:
+        want = bytes(data["digest"]).decode()
+        got = _payload_digest({k: data[k] for k in data.files})
+        if got != want:
+            raise ValueError(
+                f"content digest mismatch in {path} (stored "
+                f"{want[:12]}…, computed {got[:12]}…): corrupt snapshot")
+    # pre-digest snapshots (older writers) load unverified
     meta = json.loads(bytes(data["meta"]).decode())
     # "lanes" and "seq" snapshots share the canonical payload layout
     # and restore into EITHER engine (cross-engine restore); "seqjava"
@@ -299,7 +357,8 @@ def _restore_one(path: str, shards: Optional[int], width: Optional[int]):
     return ses
 
 
-def save_seq_session(ckpt_dir: str, session, offset: int) -> str:
+def save_seq_session(ckpt_dir: str, session, offset: int,
+                     keep: Optional[int] = None) -> str:
     """Snapshot a SeqSession at input offset `offset` in the SAME
     canonical layout as lanes snapshots (slot_* / flat s64 positions /
     bal), so snapshots restore across ENGINES as well as across
@@ -307,7 +366,7 @@ def save_seq_session(ckpt_dir: str, session, offset: int) -> str:
     from kme_tpu.engine import seq as SQ
 
     if session.cfg.compat == "java":
-        return _save_seqjava(ckpt_dir, session, offset)
+        return _save_seqjava(ckpt_dir, session, offset, keep=keep)
     os.makedirs(ckpt_dir, exist_ok=True)
     canon = SQ.export_canonical(session.cfg, session.state)
     r = session.router
@@ -332,10 +391,11 @@ def save_seq_session(ckpt_dir: str, session, offset: int) -> str:
     payload["filloff"] = np.zeros(1, np.int64)
     payload["meta"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
-    return _atomic_savez(ckpt_dir, offset, payload)
+    return _atomic_savez(ckpt_dir, offset, payload, keep=keep)
 
 
-def _save_seqjava(ckpt_dir: str, session, offset: int) -> str:
+def _save_seqjava(ckpt_dir: str, session, offset: int,
+                  keep: Optional[int] = None) -> str:
     """Snapshot a java-mode SeqSession: the canonical java form
     (runtime/javasnap.py) — flat 128-bit-key position arrays (Q11
     garbage keys included: they are parity-relevant state), resting
@@ -360,7 +420,7 @@ def _save_seqjava(ckpt_dir: str, session, offset: int) -> str:
                if k not in ("aid_idx", "sid_lane", "oid_sid")}
     payload["meta"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
-    return _atomic_savez(ckpt_dir, offset, payload)
+    return _atomic_savez(ckpt_dir, offset, payload, keep=keep)
 
 
 def _seqjava_snap_from_file(data, meta) -> dict:
@@ -480,25 +540,29 @@ def _restore_seq_one(path: str, cfg):
 # ---------------------------------------------------------------------------
 # native-engine snapshots (text store dump + a JSON header line)
 
-def save_native(ckpt_dir: str, engine, offset: int) -> str:
+def save_native(ckpt_dir: str, engine, offset: int,
+                keep: Optional[int] = None) -> str:
     """Snapshot a NativeOracleEngine: JSON header (compat + envelope +
-    offset) on line one, then the store dump."""
+    offset + dump digest) on line one, then the store dump."""
     os.makedirs(ckpt_dir, exist_ok=True)
+    dump = engine.dump_state()
     header = json.dumps({
         "version": 1, "kind": "native", "offset": int(offset),
         "compat": "java" if engine.java else "fixed",
         "book_slots": engine.book_slots, "max_fills": engine.max_fills,
+        "digest": hashlib.sha256(dump.encode("utf-8")).hexdigest(),
     })
     path = os.path.join(ckpt_dir, f"ckpt-{offset}.nat")
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         f.write(header + "\n")
-        f.write(engine.dump_state())
+        f.write(dump)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
     _fsync_dir(ckpt_dir)
-    _prune(ckpt_dir, re.compile(r"^ckpt-(\d+)\.nat$"))
+    _post_write_faults(path)
+    _prune(ckpt_dir, re.compile(r"^ckpt-(\d+)\.nat$"), keep=keep)
     return path
 
 
@@ -522,10 +586,19 @@ def load_native(ckpt_dir: str):
                 header = json.loads(f.readline())
                 if header.get("version") != 1 or header.get("kind") != "native":
                     raise ValueError("unsupported snapshot")
+                dump = f.read()
+                want = header.get("digest")
+                if want is not None:  # pre-digest snapshots load as-is
+                    got = hashlib.sha256(dump.encode("utf-8")).hexdigest()
+                    if got != want:
+                        raise ValueError(
+                            f"content digest mismatch (stored "
+                            f"{want[:12]}…, computed {got[:12]}…): "
+                            f"corrupt snapshot")
                 eng = NativeOracleEngine(header["compat"],
                                          book_slots=header["book_slots"],
                                          max_fills=header["max_fills"])
-                eng.load_state(f.read())
+                eng.load_state(dump)
             return eng, offset
         except Exception as e:
             print(f"kme_tpu.checkpoint: skipping unreadable snapshot "
@@ -536,20 +609,27 @@ def load_native(ckpt_dir: str):
 # ---------------------------------------------------------------------------
 # oracle-engine snapshots (the scalar replica is plain host state)
 
-def save_oracle(ckpt_dir: str, oracle, offset: int) -> str:
+def save_oracle(ckpt_dir: str, oracle, offset: int,
+                keep: Optional[int] = None) -> str:
+    """The engine is pickled to bytes FIRST so the blob can carry a
+    sha256 of exactly those bytes — load verifies the digest before
+    unpickling, so a bit-flip that still pickle-parses is caught."""
     import pickle
 
     os.makedirs(ckpt_dir, exist_ok=True)
+    engine_pkl = pickle.dumps(oracle)
     path = os.path.join(ckpt_dir, f"ckpt-{offset}.pkl")
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         pickle.dump({"version": 1, "kind": "oracle", "offset": int(offset),
-                     "engine": oracle}, f)
+                     "engine_pkl": engine_pkl,
+                     "digest": hashlib.sha256(engine_pkl).hexdigest()}, f)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
     _fsync_dir(ckpt_dir)
-    _prune(ckpt_dir, re.compile(r"^ckpt-(\d+)\.pkl$"))
+    _post_write_faults(path)
+    _prune(ckpt_dir, re.compile(r"^ckpt-(\d+)\.pkl$"), keep=keep)
     return path
 
 
@@ -572,7 +652,15 @@ def load_oracle(ckpt_dir: str):
                 blob = pickle.load(f)
             if blob.get("version") != 1 or blob.get("kind") != "oracle":
                 raise ValueError("unsupported snapshot")
-            return blob["engine"], offset
+            if "engine_pkl" in blob:
+                got = hashlib.sha256(blob["engine_pkl"]).hexdigest()
+                if got != blob.get("digest"):
+                    raise ValueError(
+                        f"content digest mismatch (stored "
+                        f"{str(blob.get('digest'))[:12]}…, computed "
+                        f"{got[:12]}…): corrupt snapshot")
+                return pickle.loads(blob["engine_pkl"]), offset
+            return blob["engine"], offset   # pre-digest snapshot format
         except Exception as e:
             print(f"kme_tpu.checkpoint: skipping unreadable snapshot "
                   f"{path}: {e}", file=sys.stderr)

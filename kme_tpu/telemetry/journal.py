@@ -447,12 +447,26 @@ class Journal:
 
     def _write(self, events: List[dict]) -> None:
         if self.fmt == "binary":
-            self._f.write(b"".join(_encode(ev) for ev in events))
+            blob = b"".join(_encode(ev) for ev in events)
         else:
-            self._f.write("".join(
+            blob = "".join(
                 json.dumps(ev, sort_keys=True,
                            separators=(",", ":")) + "\n"
-                for ev in events).encode())
+                for ev in events).encode()
+        from kme_tpu import faults
+
+        if faults.should("journal.torn"):
+            # kme-chaos: crash mid-append — half the batch's bytes reach
+            # the file, then the process dies with no cleanup. The next
+            # incarnation's _resume_tail must truncate/drop the torn
+            # record (appending after it would corrupt the interior).
+            import signal as _sig
+
+            self._f.write(blob[:max(1, len(blob) // 2)])
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            os.kill(os.getpid(), _sig.SIGKILL)
+        self._f.write(blob)
         if self.fsync == "batch":
             self._f.flush()
             os.fsync(self._f.fileno())

@@ -53,6 +53,10 @@ _FIELDS = ("action", "oid", "aid", "sid", "price", "size")
 #   7     rej_other        non-trade device op refused (create/transfer/
 #                          add_symbol)
 #   8     rej_unspecified  host engines (native/oracle) report no cause
+#   9     rej_overload     bounded ingress queue shed the record before
+#                          the engine (broker backpressure — the
+#                          producer saw BrokerOverload and should back
+#                          off and retry; never silently dropped)
 REJ_NONE = 0
 REJ_CAPACITY = 1
 REJ_RISK = 2
@@ -62,6 +66,7 @@ REJ_BARRIER = 5
 REJ_MALFORMED = 6
 REJ_OTHER = 7
 REJ_UNSPECIFIED = 8
+REJ_OVERLOAD = 9
 
 REJ_NAMES = {
     REJ_NONE: "ok",
@@ -73,6 +78,7 @@ REJ_NAMES = {
     REJ_MALFORMED: "rej_malformed",
     REJ_OTHER: "rej_other",
     REJ_UNSPECIFIED: "rej_unspecified",
+    REJ_OVERLOAD: "rej_overload",
 }
 
 

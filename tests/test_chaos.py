@@ -1,0 +1,122 @@
+"""kme-chaos: the at-least-once stream verifier (pure logic, fast) and
+a small end-to-end chaos run under the full fault schedule (slow)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kme_tpu.bridge.chaos import default_schedule, verify_stream
+
+# oracle groups: message 0 -> [a0, a1], message 1 -> [b0], message 2 ->
+# [] (a dropped/rejected-silent record), message 3 -> [d0, d1, d2]
+G = [["a0", "a1"], ["b0"], [], ["d0", "d1", "d2"]]
+FLAT = [ln for g in G for ln in g]
+
+
+def test_verify_exact_stream_passes():
+    ok, d = verify_stream(list(FLAT), G)
+    assert ok
+    assert d["replays"] == 0 and d["replayed_messages"] == 0
+    assert d["messages"] == 4 and d["expected_lines"] == len(FLAT)
+
+
+def test_verify_replay_from_snapshot_passes():
+    # crash after message 1, resume from a snapshot at message 0:
+    # messages 0..1 replay before the stream completes
+    got = ["a0", "a1", "b0"] + FLAT
+    ok, d = verify_stream(got, G)
+    assert ok
+    assert d["replays"] == 1 and d["replayed_messages"] == 2
+
+
+def test_verify_partial_group_then_replay_passes():
+    # crash MID-message-3 (one of three lines produced), resume from
+    # message 1
+    got = ["a0", "a1", "b0", "d0", "b0", "d0", "d1", "d2"]
+    ok, d = verify_stream(got, G)
+    assert ok
+    assert d["replays"] == 1 and d["replayed_messages"] == 2
+
+
+def test_verify_trailing_replay_passes():
+    # crash after everything was produced but before the snapshot
+    # caught up: the restart re-produces a tail
+    got = FLAT + ["d0", "d1", "d2"]
+    ok, d = verify_stream(got, G)
+    assert ok and d["replays"] == 1
+
+
+def test_verify_double_replay_passes():
+    got = (["a0", "a1"]                 # crash after msg 0
+           + ["a0", "a1", "b0"]        # replay, crash after msg 1
+           + FLAT)                     # replay from 0, complete
+    ok, d = verify_stream(got, G)
+    assert ok and d["replays"] == 2 and d["replayed_messages"] == 3
+
+
+def test_verify_rejects_divergence():
+    bad = list(FLAT)
+    bad[2] = "WRONG"
+    ok, d = verify_stream(bad, G)
+    assert not ok and "divergence" in d["error"]
+
+
+def test_verify_rejects_missing_tail():
+    ok, d = verify_stream(FLAT[:-1], G)
+    assert not ok and "incomplete" in d["error"]
+
+
+def test_verify_rejects_skipped_message():
+    # message 1's output missing entirely: looks like a replay that
+    # never completes group 1
+    got = ["a0", "a1", "d0", "d1", "d2"]
+    ok, _ = verify_stream(got, G)
+    assert not ok
+
+
+def test_verify_empty_inputs():
+    ok, _ = verify_stream([], [])
+    assert ok
+    ok, _ = verify_stream([], [["x"]])
+    assert not ok
+
+
+def test_default_schedule_covers_required_fault_classes():
+    sched = default_schedule(0, 1000, journal=True)
+    for point in ("broker.produce", "broker.fetch", "tcp.partial",
+                  "ckpt.torn", "ckpt.bitflip", "serve.kill",
+                  "serve.stuck", "journal.torn"):
+        assert point in sched
+    assert "seed=0" in sched
+    assert "serve.kill:at=500" in sched    # scales with the workload
+    assert "journal.torn" not in default_schedule(0, 1000, journal=False)
+
+
+@pytest.mark.slow
+def test_chaos_end_to_end_byte_exact(tmp_path):
+    """The acceptance run, scaled down: a seeded schedule covering
+    broker I/O errors, torn + bit-flipped checkpoints, a SIGKILL at an
+    exact offset and a stuck step() — the completed MatchOut stream
+    must verify byte-exactly against the oracle with >= 1 automatic
+    restart."""
+    run_dir = str(tmp_path / "run")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "kme_tpu.cli", "chaos", "--seed", "0",
+         "--events", "600", "--dir", run_dir, "--timeout", "180"],
+        env=env, capture_output=True, text=True, timeout=300)
+    sys.stderr.write(proc.stderr[-4000:])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(os.path.join(run_dir, "chaos-report.json")) as f:
+        report = json.load(f)
+    assert report["ok"] and not report["failures"]
+    assert report["restarts_total"] >= 1
+    assert report["verify"]["replayed_messages"] >= 0
+    fired_points = {k.split(".", 1)[1] for k in report["fault_fires"]}
+    assert {"serve.kill", "ckpt.torn", "ckpt.bitflip"} <= fired_points
+    assert report["recovery_seconds_max"] is not None
+    # the flight recorder survived the crashes too
+    assert os.path.exists(os.path.join(run_dir, "journal.jsonl"))

@@ -548,3 +548,124 @@ def test_journal_across_crash_resume(tmp_path):
     want = canonical_lines(oracle_events(
         [dumps_order(m) for m in msgs], book_slots=64, max_fills=32))
     assert canonical_lines(evs) == want
+
+
+# ---------------------------------------------------------------------------
+# corrupt-newest-snapshot fallback (silent corruption, not just torn
+# writes) and retention depth
+
+
+def test_digest_mismatch_snapshot_falls_back(tmp_path):
+    """Silent corruption: the newest snapshot still np.load-parses (so
+    zipfile CRCs pass) but one array was modified while its stored
+    digest went stale — the CONTENT digest must catch it and the loader
+    falls back to the previous snapshot."""
+    import numpy as np
+
+    msgs = _stream(300, seed=9)
+    ses = LaneSession(CFG)
+    ses.process_wire([m.copy() for m in msgs[:100]])
+    ck.save_session(str(tmp_path), ses, offset=100)
+    ses.process_wire([m.copy() for m in msgs[100:200]])
+    ck.save_session(str(tmp_path), ses, offset=200)
+
+    path = ck.snapshot_path(str(tmp_path), 200)
+    data = {k: v.copy() for k, v in np.load(path).items()}
+    tampered = data["pos_amt"].copy()
+    tampered.flat[0] += 1                 # one balance, one tick off
+    data["pos_amt"] = tampered            # digest array kept STALE
+    with open(path, "wb") as f:
+        np.savez(f, **data)
+
+    resumed, offset = ck.load_session(str(tmp_path))
+    assert offset == 100 and resumed is not None
+    with pytest.raises(ValueError, match="digest mismatch"):
+        ck._load_file(path)
+
+
+def test_oracle_bitflip_inside_engine_falls_back(tmp_path):
+    """A bit-flip INSIDE the pickled engine bytes leaves the outer blob
+    parseable — only the engine_pkl sha256 can catch it; load_oracle
+    must skip to the previous snapshot."""
+    ora = OracleEngine("fixed", book_slots=64, max_fills=32)
+    msgs = harness_stream(60, seed=11, num_accounts=4, num_symbols=2,
+                          payout_opcode_bug=False, validate=True)
+    for m in msgs[:30]:
+        ora.process(m)
+    ck.save_oracle(str(tmp_path), ora, 100)
+    for m in msgs[30:]:
+        ora.process(m)
+    ck.save_oracle(str(tmp_path), ora, 200)
+
+    import pickle
+
+    path = os.path.join(str(tmp_path), "ckpt-200.pkl")
+    with open(path, "rb") as f:
+        raw = bytearray(f.read())
+    engine_pkl = pickle.loads(bytes(raw))["engine_pkl"]
+    at = raw.index(engine_pkl) + len(engine_pkl) // 2
+    raw[at] ^= 0x10
+    with open(path, "wb") as f:
+        f.write(raw)
+    # the outer blob still parses — the digest is the only defence
+    assert pickle.loads(bytes(raw))["engine_pkl"] != engine_pkl
+
+    loaded, offset = ck.load_oracle(str(tmp_path))
+    assert offset == 100 and loaded is not None
+
+
+def test_all_snapshots_corrupt_cold_start(tmp_path):
+    """Every snapshot unreadable: the loader returns (None, 0) rather
+    than raising, and a service pointed at the wreckage starts cold at
+    offset 0 and replays the whole stream byte-exactly."""
+    msgs = harness_stream(80, seed=17, num_accounts=4, num_symbols=2,
+                          payout_opcode_bug=False, validate=True)
+    ora = OracleEngine("fixed", book_slots=64, max_fills=32)
+    want = [r.wire() for m in msgs for r in ora.process(m.copy())]
+
+    ck_dir = str(tmp_path / "ck")
+    ses = LaneSession(CFG)
+    ses.process_wire([m.copy() for m in _stream(100, seed=3)])
+    ck.save_session(ck_dir, ses, offset=50)
+    ck.save_session(ck_dir, ses, offset=100)
+    for off, path in ck.list_snapshots(ck_dir):
+        with open(path, "r+b") as f:
+            f.truncate(64)
+    assert ck.load_session(ck_dir) == (None, 0)
+
+    broker = InProcessBroker()
+    provision(broker)
+    for m in msgs:
+        broker.produce(TOPIC_IN, None, dumps_order(m))
+    svc = MatchService(broker, engine="oracle", compat="fixed", batch=16,
+                       slots=64, max_fills=32, checkpoint_dir=ck_dir,
+                       checkpoint_every=1000)
+    assert svc.offset == 0                 # cold start, not a crash
+    assert svc.run(max_messages=len(msgs)) == len(msgs)
+    got = [f"{r.key} {r.value}" for r in broker.fetch("MatchOut", 0, 10**6)]
+    assert got == want
+
+
+def test_retention_keep_depth(tmp_path, monkeypatch):
+    """keep= bounds the snapshot tail; KME_CKPT_KEEP sets the default
+    (3 — newest + two fallbacks, since kme-chaos both tears AND
+    bit-flips)."""
+    ses = LaneSession(CFG)
+    ses.process_wire([m.copy() for m in _stream(50, seed=2)])
+
+    d1 = str(tmp_path / "explicit")
+    for off in (10, 20, 30, 40):
+        ck.save_session(d1, ses, offset=off, keep=2)
+    assert [o for o, _ in ck.list_snapshots(d1)] == [40, 30]
+
+    d2 = str(tmp_path / "default")
+    monkeypatch.delenv("KME_CKPT_KEEP", raising=False)
+    for off in (10, 20, 30, 40, 50):
+        ck.save_session(d2, ses, offset=off)
+    assert [o for o, _ in ck.list_snapshots(d2)] == [50, 40, 30]
+
+    d3 = str(tmp_path / "env")
+    monkeypatch.setenv("KME_CKPT_KEEP", "1")
+    for off in (10, 20):
+        ck.save_session(d3, ses, offset=off)
+    assert [o for o, _ in ck.list_snapshots(d3)] == [20]

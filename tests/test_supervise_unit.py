@@ -1,0 +1,222 @@
+"""Supervisor policy logic under an injectable clock: detection
+(stale heartbeat, frozen tick, grace window), jittered exponential
+backoff keyed on failure fingerprints, the healthy-uptime budget
+refund, recovery-time measurement and the supervisor.json mirror —
+all in milliseconds of real time (no child processes, no sleeps)."""
+
+import json
+import os
+import random
+
+import pytest
+
+from kme_tpu.bridge.supervise import STATE_FILE, Supervisor
+
+
+class FakeChild:
+    """A scripted child: exits `rc` once the fake clock passes
+    spawn + exit_after (None = runs forever until killed)."""
+
+    def __init__(self, clock, exit_after=None, rc=1):
+        self._clock = clock
+        self.exit_after = exit_after
+        self.rc = rc
+        self.returncode = None
+        self.spawned_at = None
+        self.env = None
+
+    def poll(self):
+        if (self.returncode is None and self.exit_after is not None
+                and self._clock() - self.spawned_at >= self.exit_after):
+            self.returncode = self.rc
+        return self.returncode
+
+    def send_signal(self, sig):
+        self.returncode = -9
+
+    def wait(self):
+        return self.returncode
+
+
+class Harness:
+    """Fake clock + scripted children wired into a Supervisor."""
+
+    def __init__(self, tmp_path, n_children=8, **kw):
+        self.now = 0.0
+        self.sleeps = []
+        self.spawned = []
+        self._pending = [FakeChild(self.clock) for _ in range(n_children)]
+        # heartbeat model: age() -> seconds (inf = no file yet);
+        # tick() -> loop tick value. Tests swap these mid-run.
+        self.age = lambda: 0.1
+        self.tick = lambda: int(self.now * 10)    # always advancing
+        sup = Supervisor([], str(tmp_path),
+                         popen=self._popen, clock=self.clock,
+                         sleep=self._sleep,
+                         mtime=lambda p: self._mtime(),
+                         rng=random.Random(0), poll=0.5, **kw)
+        sup._hb_tick = self.tick_wrap
+        self.sup = sup
+
+    def clock(self):
+        return self.now
+
+    def _sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+        if self.now > 100000:
+            raise AssertionError("supervisor loop ran away")
+
+    def _mtime(self):
+        age = self.age()
+        if age == float("inf"):
+            raise OSError("no heartbeat file")
+        return self.now - age
+
+    def tick_wrap(self):
+        return self.tick()
+
+    def _popen(self, cmd, env):
+        child = self._pending[len(self.spawned)]
+        child.spawned_at = self.now
+        child.env = env
+        self.spawned.append(child)
+        return child
+
+    @property
+    def backoffs(self):
+        """Sleeps that are not the 0.5s poll cadence."""
+        return [s for s in self.sleeps if s != 0.5]
+
+
+def test_clean_exit_no_restart(tmp_path):
+    h = Harness(tmp_path)
+    h._pending[0].exit_after, h._pending[0].rc = 2.0, 0
+    assert h.sup.run() == 0
+    assert len(h.spawned) == 1
+    assert h.sup.restarts_total == 0
+    assert h.spawned[0].env["KME_RESTART_ORDINAL"] == "0"
+    assert "KME_FAILED_AT" not in h.spawned[0].env
+
+
+def test_crash_loop_exhausts_budget_with_growing_backoff(tmp_path):
+    h = Harness(tmp_path, max_restarts=3, healthy_decay=10_000,
+                backoff_base=1.0, backoff_cap=100.0)
+    for c in h._pending:
+        c.exit_after, c.rc = 0.0, 1        # dies instantly, forever
+    assert h.sup.run() == 1
+    assert len(h.spawned) == 4             # initial + 3 restarts
+    assert h.sup.restarts_total == 4
+    assert h.sup.fingerprints == {"exit:1": 4}
+    # three backoff sleeps (the 4th failure exhausts the budget before
+    # any backoff), doubling with jitter in [0.5, 1.5)x
+    b = h.backoffs
+    assert len(b) == 3
+    assert 0.5 <= b[0] < 1.5
+    assert 1.0 <= b[1] < 3.0
+    assert 2.0 <= b[2] < 6.0
+    # restart ordinals stamped into each incarnation's environment
+    assert [c.env["KME_RESTART_ORDINAL"] for c in h.spawned] == \
+        ["0", "1", "2", "3"]
+    assert all("KME_FAILED_AT" in c.env for c in h.spawned[1:])
+
+
+def test_novel_fingerprint_resets_backoff_streak(tmp_path):
+    h = Harness(tmp_path, max_restarts=10, healthy_decay=10_000,
+                backoff_base=1.0, backoff_cap=100.0)
+    for i, c in enumerate(h._pending):
+        c.exit_after = 0.0
+        c.rc = 1 if i < 2 else 2           # fingerprint changes
+        if i >= 3:
+            c.rc = 0                       # then exit cleanly
+    assert h.sup.run() == 0
+    assert h.sup.fingerprints == {"exit:1": 2, "exit:2": 1}
+    b = h.backoffs
+    assert len(b) == 3
+    assert 1.0 <= b[1] < 3.0               # streak 2 of exit:1
+    assert 0.5 <= b[2] < 1.5               # exit:2 resets to streak 1
+
+
+def test_stale_heartbeat_detected(tmp_path):
+    h = Harness(tmp_path, stale_after=5.0, grace=1.0)
+    h._pending[1].exit_after, h._pending[1].rc = 1.0, 0
+    # the FIRST incarnation's heartbeat freezes at t=3; the restarted
+    # child beats normally
+    h.age = lambda: (0.1 if len(h.spawned) >= 2 or h.now < 3.0
+                     else h.now - 3.0)
+    assert h.sup.run() == 0
+    assert h.sup.fingerprints == {"stale": 1}
+    assert h.sup.restarts_total == 1
+    assert h.spawned[0].returncode == -9   # SIGKILLed after detection
+
+
+def test_frozen_tick_is_a_stall_even_with_fresh_heartbeat(tmp_path):
+    h = Harness(tmp_path, stall_after=3.0, stale_after=10_000)
+    h._pending[1].exit_after, h._pending[1].rc = 1.0, 0
+    h.age = lambda: 0.1                        # beater thread alive
+    h.tick = lambda: min(int(h.now), 2)        # advances, then freezes
+    assert h.sup.run() == 0
+    assert h.sup.fingerprints == {"stall": 1}
+
+
+def test_no_heartbeat_within_grace_fails(tmp_path):
+    h = Harness(tmp_path, grace=4.0)
+    h._pending[1].exit_after, h._pending[1].rc = 1.0, 0
+    first = {"done": False}
+
+    def age():
+        # first incarnation never writes a heartbeat; the restarted
+        # one is healthy immediately
+        return float("inf") if len(h.spawned) < 2 else 0.1
+
+    h.age = age
+    assert h.sup.run() == 0
+    assert h.sup.fingerprints == {"stale": 1}
+    # detection happened only after the grace window
+    assert h.sup.recoveries == [] or h.sup.recoveries[0]["detected_at"] >= 4.0
+
+
+def test_healthy_uptime_refunds_budget(tmp_path):
+    h = Harness(tmp_path, max_restarts=2, healthy_decay=5.0)
+    h._pending[0].exit_after, h._pending[0].rc = 0.0, 1
+    h._pending[1].exit_after, h._pending[1].rc = 12.0, 0  # long healthy run
+    assert h.sup.run() == 0
+    assert h.sup.restarts_total == 1       # lifetime count unchanged
+    assert h.sup.budget_used == 0          # refunded by healthy uptime
+
+
+def test_recovery_time_measured_and_state_mirrored(tmp_path):
+    h = Harness(tmp_path, grace=30.0)
+    h._pending[0].exit_after, h._pending[0].rc = 2.0, 1
+    h._pending[1].exit_after, h._pending[1].rc = 10.0, 0
+
+    def age():
+        if len(h.spawned) < 2:
+            return 0.1
+        # restarted child's first heartbeat lands 1.5s after spawn
+        born = h.spawned[1].spawned_at
+        return float("inf") if h.now < born + 1.5 else 0.1
+
+    h.age = age
+    assert h.sup.run() == 0
+    assert len(h.sup.recoveries) == 1
+    rec = h.sup.recoveries[0]
+    assert rec["fingerprint"] == "exit:1"
+    assert 1.0 <= rec["recovered_in"] <= 4.0
+    # the child was told when the failure was detected
+    assert float(h.spawned[1].env["KME_FAILED_AT"]) == rec["detected_at"]
+    # supervisor.json mirrors the final state
+    with open(os.path.join(str(tmp_path), STATE_FILE)) as f:
+        state = json.load(f)
+    assert state["restarts_total"] == 1
+    assert state["fingerprints"] == {"exit:1": 1}
+    assert state["recoveries"][0]["recovered_in"] == rec["recovered_in"]
+
+
+def test_reserved_serve_args_rejected(tmp_path):
+    for bad in ("--checkpoint-dir", "--checkpoint-dir=/x",
+                "--health-file", "--health", "--check"):
+        with pytest.raises(ValueError, match="managed by the supervisor"):
+            Supervisor([bad, "v"], str(tmp_path))
+    # non-prefix flags pass through
+    Supervisor(["--engine", "oracle", "--batch", "64"], str(tmp_path))
