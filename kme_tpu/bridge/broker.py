@@ -18,6 +18,25 @@ the Kafka-retains-the-log property the engine's checkpoint/resume
 contract depends on (the restored MatchIn offset must still address the
 same records after a broker restart). A torn trailing line (crash mid-
 append) is dropped on reload.
+
+Exactly-once visible output (the path the reference commented out at
+KProcessor.java:29) is built from two broker-side rules applied to
+records carrying an ``(epoch, out_seq)`` produce stamp:
+
+- **fencing**: a produce stamped with an epoch below the broker's fence
+  raises BrokerFenced — a deposed leader can never make a write
+  visible. The fence advances to any higher epoch seen (produce or an
+  explicit ``fence()`` from a newly promoted leader) and is recovered
+  from the stamps in the log on reload.
+- **idempotent produce**: per topic, a stamped record whose ``out_seq``
+  is at or below the durable watermark is suppressed (no append,
+  ``dup_suppressed`` counts it) — a restarted leader deterministically
+  re-produces its post-snapshot tail with the SAME stamps, so the
+  durable log itself stays duplicate-free.
+
+Unstamped produces behave exactly as before; log lines stay
+``[key,value]`` for them and gain two elements (``[key,value,epoch,
+out_seq]``) only when stamped, so pre-existing logs load unchanged.
 """
 
 from __future__ import annotations
@@ -44,11 +63,21 @@ class BrokerOverload(BrokerError):
     code = "rej_overload"
 
 
+class BrokerFenced(BrokerError):
+    """A produce stamped with a stale leader epoch. Not retryable: the
+    producer has been deposed and must exit so its supervisor can
+    restart it under a fresh epoch (serve exits 75)."""
+
+    code = "fenced"
+
+
 @dataclasses.dataclass(frozen=True)
 class Record:
     offset: int
     key: Optional[str]
     value: str
+    epoch: Optional[int] = None
+    out_seq: Optional[int] = None
 
 
 class _Topic:
@@ -57,6 +86,10 @@ class _Topic:
         self.partitions = partitions
         self.log: List[Record] = []
         self.logfile = logfile
+        # idempotent-produce watermark: highest out_seq made durable on
+        # this topic (-1 = no stamped record yet); recovered from the
+        # log stamps on reload.
+        self.max_out_seq = -1
 
 
 class InProcessBroker:
@@ -78,6 +111,10 @@ class InProcessBroker:
         self._max_lag = max_lag
         self._commits: Dict[str, int] = {}
         self.overload_rejects = 0
+        # exactly-once state (recovered from log stamps on reload)
+        self._fence_epoch = 0
+        self.fenced_produces = 0
+        self.dup_suppressed = 0
         if persist_dir is not None:
             os.makedirs(persist_dir, exist_ok=True)
             for name in sorted(os.listdir(persist_dir)):
@@ -108,7 +145,12 @@ class InProcessBroker:
                 torn_at = pos  # unterminated trailing append
                 break
             try:
-                key, value = json.loads(data[pos:nl].decode("utf-8"))
+                row = json.loads(data[pos:nl].decode("utf-8"))
+                if len(row) not in (2, 4):
+                    raise ValueError(f"bad row arity {len(row)}")
+                key, value = row[0], row[1]
+                epoch = row[2] if len(row) == 4 else None
+                out_seq = row[3] if len(row) == 4 else None
             except (ValueError, TypeError, UnicodeDecodeError):
                 # produce() appends each record as ONE newline-terminated
                 # write, and partial writes are prefixes — so any line
@@ -119,7 +161,12 @@ class InProcessBroker:
                     f"corrupt record in {path} at byte {pos}: refusing "
                     f"to load (only an unterminated final line is "
                     f"repairable; committed records are immutable)")
-            topic.log.append(Record(len(topic.log), key, value))
+            topic.log.append(Record(len(topic.log), key, value,
+                                    epoch, out_seq))
+            if out_seq is not None:
+                topic.max_out_seq = max(topic.max_out_seq, int(out_seq))
+            if epoch is not None:
+                self._fence_epoch = max(self._fence_epoch, int(epoch))
             pos = nl + 1
         if torn_at is not None:
             print(f"broker: dropping torn tail of {path} at byte {torn_at} "
@@ -154,14 +201,31 @@ class InProcessBroker:
 
     # -- data path ------------------------------------------------------
 
-    def produce(self, topic: str, key: Optional[str], value: str) -> int:
-        """Append one record; returns its offset."""
+    def produce(self, topic: str, key: Optional[str], value: str,
+                epoch: Optional[int] = None,
+                out_seq: Optional[int] = None) -> int:
+        """Append one record; returns its offset. With an
+        ``(epoch, out_seq)`` stamp the append is fenced and idempotent:
+        a stale epoch raises BrokerFenced, and an ``out_seq`` at or
+        below the topic's durable watermark is suppressed (returns -1,
+        nothing appended) — replayed tails after a crash vanish here
+        instead of surfacing to consumers."""
         if faults.should("broker.produce"):
             raise BrokerError("injected fault: broker.produce")
         with self._data:
             t = self._topics.get(topic)
             if t is None:
                 raise BrokerError(f"unknown topic {topic!r}")
+            if epoch is not None:
+                if epoch < self._fence_epoch:
+                    self.fenced_produces += 1
+                    raise BrokerFenced(
+                        f"fenced: produce to {topic!r} from stale epoch "
+                        f"{epoch} < fence {self._fence_epoch}")
+                self._fence_epoch = epoch
+            if out_seq is not None and out_seq <= t.max_out_seq:
+                self.dup_suppressed += 1
+                return -1
             if (self._max_lag is not None and topic in self._commits
                     and len(t.log) - self._commits[topic]
                     >= self._max_lag):
@@ -171,13 +235,31 @@ class InProcessBroker:
                     f"{len(t.log) - self._commits[topic]} >= max_lag "
                     f"{self._max_lag}")
             off = len(t.log)
-            t.log.append(Record(off, key, value))
+            t.log.append(Record(off, key, value, epoch, out_seq))
+            if out_seq is not None:
+                t.max_out_seq = out_seq
             if t.logfile is not None:
-                t.logfile.write(json.dumps([key, value],
+                row = ([key, value] if epoch is None and out_seq is None
+                       else [key, value, epoch, out_seq])
+                t.logfile.write(json.dumps(row,
                                            separators=(",", ":")) + "\n")
                 t.logfile.flush()
             self._data.notify_all()
             return off
+
+    def fence(self, epoch: int) -> None:
+        """Advance the fence so every produce stamped below `epoch` is
+        rejected. A newly promoted leader calls this at startup: the
+        reloaded log only teaches the broker its PREDECESSORS' epochs,
+        so without an explicit fence a zombie old leader holding the
+        previous epoch would still get through."""
+        with self._lock:
+            self._fence_epoch = max(self._fence_epoch, int(epoch))
+
+    @property
+    def fence_epoch(self) -> int:
+        with self._lock:
+            return self._fence_epoch
 
     def fetch(self, topic: str, offset: int, max_records: int = 1024,
               timeout: float = 0.0) -> List[Record]:

@@ -35,6 +35,17 @@ The run:
 Exit 0 iff the stream verifies, the supervisor exited cleanly and at
 least --min-restarts automatic restarts happened (a chaos run where
 nothing died proves nothing).
+
+--scenario failover drills the exactly-once failover stack instead:
+the leader runs with a hot standby (kme-supervise --standby), one
+seeded SIGKILL lands mid-stream, and the run only passes if the
+supervisor promoted the replica within --max-failover seconds, the
+promoted epoch is visible in the log's produce stamps, a stale-epoch
+produce is fenced post-mortem, broker-side dedup suppressed the
+promoted leader's replayed overlap (dup_suppressed_total > 0), and the
+deduped MatchOut stream is BYTE-EXACT against the flat oracle stream —
+zero visible duplicates (verify_failover), a strictly stronger contract
+than verify_stream's at-least-once composition.
 """
 
 from __future__ import annotations
@@ -69,6 +80,14 @@ def default_schedule(seed: int, events: int, journal: bool) -> str:
     if journal:
         clauses.append("journal.torn:n=1:after=5")  # crash mid-append
     return ";".join(clauses)
+
+
+def failover_schedule(seed: int, events: int) -> str:
+    """The failover scenario's schedule: ONE clean SIGKILL mid-stream.
+    The point under test is the promotion machinery (standby adoption,
+    epoch fencing, idempotent-produce dedup of the replayed overlap),
+    so no other fault muddies the failure fingerprint or the timing."""
+    return f"seed={seed};serve.kill:at={max(1, events // 2)}"
 
 
 def _free_port() -> int:
@@ -202,24 +221,132 @@ class _Producer(threading.Thread):
                 pass
 
 
-def read_matchout(log_dir: str) -> List[str]:
+def read_matchout_records(log_dir: str) -> list:
     """Post-mortem read of the durable MatchOut topic log (the broker
-    persists topics as JSONL under the checkpoint dir)."""
+    persists topics as JSONL under the checkpoint dir) as Records —
+    produce stamps included."""
     from kme_tpu.bridge.broker import BrokerError, InProcessBroker
 
     broker = InProcessBroker(persist_dir=log_dir)
-    out: List[str] = []
+    out: list = []
     try:
         while True:
             recs = broker.fetch(TOPIC_OUT, len(out), 4096, timeout=0.0)
             if not recs:
                 return out
-            out.extend(f"{r.key} {r.value}" for r in recs)
+            out.extend(recs)
     except BrokerError:
         return out          # topic never created (nothing got through)
     finally:
         if hasattr(broker, "close"):
             broker.close()
+
+
+def read_matchout(log_dir: str) -> List[str]:
+    return [f"{r.key} {r.value}" for r in read_matchout_records(log_dir)]
+
+
+def verify_failover(recs: list, per_msg: List[List[str]],
+                    max_epoch_floor: int = 2) -> Tuple[bool, dict]:
+    """The exactly-once failover contract over the durable MatchOut
+    records: after consumer-side dedup (bridge/consume.DedupRing) the
+    visible stream must be BYTE-EXACT equal to the flat oracle stream —
+    zero duplicates, zero gaps, zero reordering — and the log must show
+    at least two leader epochs (the promotion really happened). The
+    broker already suppresses replayed stamps at produce time, so the
+    raw log itself should carry no duplicate stamps either; any the
+    ring finds are counted and failed on."""
+    from kme_tpu.bridge.consume import DedupRing
+
+    ring = DedupRing()
+    visible = [f"{r.key} {r.value}" for r in recs
+               if not ring.is_dup(r.epoch, r.out_seq)]
+    flat = [ln for g in per_msg for ln in g]
+    epochs = sorted({r.epoch for r in recs if r.epoch is not None})
+    detail = {"got_lines": len(visible),
+              "expected_lines": len(flat),
+              "messages": len(per_msg),
+              "duplicates_in_log": ring.suppressed,
+              "unstamped_records": sum(1 for r in recs
+                                       if r.epoch is None),
+              "epochs": epochs}
+    ok = True
+    if ring.suppressed:
+        detail["error"] = (f"{ring.suppressed} duplicate produce "
+                           f"stamp(s) reached the durable log")
+        ok = False
+    elif visible != flat:
+        n = min(len(visible), len(flat))
+        div = next((k for k in range(n) if visible[k] != flat[k]), n)
+        detail["error"] = (f"deduped stream diverges from the oracle "
+                           f"at line {div} (got {len(visible)} lines, "
+                           f"want {len(flat)})")
+        ok = False
+    elif not epochs or epochs[-1] < max_epoch_floor:
+        detail["error"] = (f"no promoted epoch in the log (epochs "
+                           f"{epochs}); failover never happened")
+        ok = False
+    return ok, detail
+
+
+def _check_failover(ckpt_dir: str, log_dir: str, recoveries: list,
+                    max_failover: float, failures: List[str]) -> dict:
+    """Failover-scenario assertions beyond stream byte-exactness:
+    bounded promotion, broker-side dedup actually observed, and a
+    stale-epoch produce fenced post-mortem. Appends human-readable
+    reasons to `failures`; returns the report sub-dict."""
+    out: dict = {}
+    promoted = [r for r in recoveries if r.get("promoted")]
+    fo = [r["failover_seconds"] for r in promoted
+          if r.get("failover_seconds") is not None]
+    out["promotions"] = len(promoted)
+    out["failover_seconds"] = fo
+    if not promoted:
+        failures.append("no hot-standby promotion recorded by the "
+                        "supervisor")
+    elif fo and max(fo) > max_failover:
+        failures.append(f"failover took {max(fo):.2f}s "
+                        f"(bound {max_failover}s)")
+
+    # the promoted leader's final heartbeat carries the broker-side
+    # exactly-once counters: the replayed overlap MUST have been
+    # suppressed by the idempotent-produce watermark, otherwise the
+    # byte-exact stream above proved nothing about dedup
+    dup = fenced = None
+    try:
+        with open(os.path.join(ckpt_dir, "serve.health")) as f:
+            gauges = json.load(f).get("metrics", {}).get("gauges", {})
+        dup = gauges.get("dup_suppressed_total")
+        fenced = gauges.get("fenced_produces_total")
+        out["leader_epoch"] = gauges.get("leader_epoch")
+    except (OSError, ValueError):
+        pass
+    out["dup_suppressed_total"] = dup
+    out["fenced_produces_total"] = fenced
+    if not dup:
+        failures.append("dup_suppressed_total == 0: the promoted "
+                        "leader's replayed overlap never exercised "
+                        "broker-side dedup")
+
+    # stale-epoch probe: reload the durable logs the way a recovered
+    # broker would and produce with epoch 1 — the fence recovered from
+    # the log's stamps must reject it BEFORE anything is appended
+    from kme_tpu.bridge.broker import BrokerFenced, InProcessBroker
+
+    probe = InProcessBroker(persist_dir=log_dir)
+    try:
+        try:
+            probe.produce(TOPIC_OUT, "OUT", "stale-epoch-probe",
+                          epoch=1, out_seq=10 ** 9)
+            out["stale_epoch_fenced"] = False
+            failures.append("a stale-epoch (zombie leader) produce was "
+                            "NOT fenced post-mortem")
+        except BrokerFenced:
+            out["stale_epoch_fenced"] = True
+    finally:
+        if hasattr(probe, "close"):
+            probe.close()
+    return out
 
 
 def _fault_fires(state_dir: str) -> dict:
@@ -241,6 +368,20 @@ def main(argv=None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("--seed", type=int, default=0,
                    help="seeds the workload AND every fault rule")
+    p.add_argument("--scenario", choices=("default", "failover"),
+                   default="default",
+                   help="default = the at-least-once recovery gauntlet "
+                        "(every fault class, verify_stream); failover "
+                        "= hot-standby promotion under exactly-once: "
+                        "SIGKILL the leader mid-stream, require the "
+                        "supervisor to promote the replica with a "
+                        "higher epoch within --max-failover seconds, "
+                        "the old epoch to be fenced, and the deduped "
+                        "MatchOut stream to be byte-exact with ZERO "
+                        "visible duplicates")
+    p.add_argument("--max-failover", type=float, default=2.0,
+                   help="failover scenario: max seconds from failure "
+                        "detection to the promoted replica serving")
     p.add_argument("--events", type=int, default=2000)
     p.add_argument("--accounts", type=int, default=10)
     p.add_argument("--symbols", type=int, default=3)
@@ -289,6 +430,7 @@ def main(argv=None) -> int:
     from kme_tpu.wire import dumps_order
     from kme_tpu.workload import harness_stream
 
+    failover = args.scenario == "failover"
     run_dir = args.dir
     if run_dir is None:
         import tempfile
@@ -298,16 +440,18 @@ def main(argv=None) -> int:
     ckpt_dir = os.path.join(run_dir, "state")
     state_dir = os.path.join(run_dir, "fault-state")
     os.makedirs(ckpt_dir, exist_ok=True)
-    journal = (None if args.no_journal
+    journal = (None if args.no_journal or failover
                else os.path.join(run_dir, "journal.jsonl"))
     schedule = args.schedule
     if schedule is None:
-        schedule = default_schedule(args.seed, args.events,
-                                    journal is not None)
+        schedule = (failover_schedule(args.seed, args.events) if failover
+                    else default_schedule(args.seed, args.events,
+                                          journal is not None))
     report_path = args.report or os.path.join(run_dir,
                                               "chaos-report.json")
 
-    print(f"kme-chaos: seed={args.seed} events={args.events} "
+    print(f"kme-chaos: scenario={args.scenario} seed={args.seed} "
+          f"events={args.events} "
           f"engine={args.engine}\nkme-chaos: schedule {schedule}\n"
           f"kme-chaos: run dir {run_dir}", file=sys.stderr)
 
@@ -340,8 +484,13 @@ def main(argv=None) -> int:
                "--stall-after", str(args.stall_after),
                "--max-restarts", str(args.max_restarts),
                "--grace", str(args.grace),
-               "--backoff-base", "0.05", "--backoff-cap", "0.5",
-               "--"] + serve_args
+               "--backoff-base", "0.05", "--backoff-cap", "0.5"]
+    if failover:
+        # hot standby + a tight watch poll: the failover bound starts
+        # at failure DETECTION, but a slow detector makes for a slow
+        # drill
+        sup_cmd += ["--standby", "--poll", "0.1"]
+    sup_cmd += ["--"] + serve_args
     env = dict(os.environ)
     env["KME_FAULTS"] = schedule
     env["KME_FAULTS_STATE"] = state_dir
@@ -371,8 +520,13 @@ def main(argv=None) -> int:
     elapsed = time.time() - t0
 
     # 5. post-mortem verification against the oracle
-    got = read_matchout(os.path.join(ckpt_dir, "broker-log"))
-    ok, verify = verify_stream(got, per_msg)
+    log_dir = os.path.join(ckpt_dir, "broker-log")
+    recs = read_matchout_records(log_dir)
+    got = [f"{r.key} {r.value}" for r in recs]
+    if failover:
+        ok, verify = verify_failover(recs, per_msg)
+    else:
+        ok, verify = verify_stream(got, per_msg)
 
     sup_state = {}
     try:
@@ -398,9 +552,16 @@ def main(argv=None) -> int:
         failures.append(f"only {restarts} automatic restart(s); "
                         f"need >= {args.min_restarts}")
 
+    failover_report = None
+    if failover:
+        failover_report = _check_failover(
+            ckpt_dir, log_dir, recoveries, args.max_failover, failures)
+
     report = {
         "ok": not failures,
         "failures": failures,
+        "scenario": args.scenario,
+        "failover": failover_report,
         "seed": args.seed,
         "events": args.events,
         "engine": args.engine,
@@ -420,6 +581,15 @@ def main(argv=None) -> int:
     with open(report_path, "w") as f:
         json.dump(report, f, indent=1)
     status = "OK" if report["ok"] else "FAILED"
+    if failover_report is not None:
+        print(f"kme-chaos: failover — promotions="
+              f"{failover_report.get('promotions')} "
+              f"failover_seconds={failover_report.get('failover_seconds')} "
+              f"dup_suppressed={failover_report.get('dup_suppressed_total')} "
+              f"leader_epoch={failover_report.get('leader_epoch')} "
+              f"stale_epoch_fenced="
+              f"{failover_report.get('stale_epoch_fenced')}",
+              file=sys.stderr)
     print(f"kme-chaos: {status} — {len(got)} MatchOut lines verified "
           f"against {len(per_msg)} oracle groups "
           f"(replays={verify.get('replays', '?')}, replayed_messages="

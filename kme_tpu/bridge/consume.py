@@ -1,23 +1,62 @@
 """Fill-stream consumer — the consumer.js role
 (/root/reference/consumer.js:10-20): subscribe to `MatchOut` from the
-beginning and print one `<key> <value>` line per record."""
+beginning and print one `<key> <value>` line per record.
+
+Under the exactly-once output path every MatchOut record carries an
+`(epoch, out_seq)` produce stamp (wire.ProduceStamp) and the broker
+already suppresses replayed stamps before they reach the log; the
+DedupRing here is the consumer's defense-in-depth for streams that
+bypassed broker dedup (a log written before fencing was enabled, or a
+transport without stamp support) — it drops any stamp it has already
+seen and counts the drop in `dup_suppressed_total`."""
 
 from __future__ import annotations
 
 import argparse
+import collections
 import sys
 
 from kme_tpu.bridge.service import TOPIC_OUT
 
 
+class DedupRing:
+    """Ring of the most recent `capacity` (epoch, out_seq) produce
+    stamps. Replay after a crash is CONTIGUOUS (the post-snapshot tail),
+    so a ring bounded well above the checkpoint interval catches every
+    real duplicate without unbounded memory; unstamped records pass
+    through untouched."""
+
+    def __init__(self, capacity: int = 65536) -> None:
+        self.capacity = max(1, int(capacity))
+        self._order = collections.deque()
+        self._seen = set()
+        self.suppressed = 0
+
+    def is_dup(self, epoch, out_seq) -> bool:
+        """True (and counted) when this stamp was already seen."""
+        if epoch is None or out_seq is None:
+            return False
+        stamp = (epoch, out_seq)
+        if stamp in self._seen:
+            self.suppressed += 1
+            return True
+        self._seen.add(stamp)
+        self._order.append(stamp)
+        if len(self._order) > self.capacity:
+            self._seen.discard(self._order.popleft())
+        return False
+
+
 def consume_lines(broker, offset: int = 0, follow: bool = True,
-                  poll_timeout: float = 0.5, idle_exit: float = None):
+                  poll_timeout: float = 0.5, idle_exit: float = None,
+                  dedup: DedupRing = None):
     """Yield `<key> <value>` lines from MatchOut starting at `offset`.
     follow=False stops at the current end; idle_exit stops after that
     many idle seconds. While following, a missing topic is polled for
     (subscribe-and-wait, like the reference consumer and
     MatchService.step) instead of crashing a consumer that was started
-    before provisioning."""
+    before provisioning. `dedup` suppresses records whose produce stamp
+    the ring has already seen."""
     import time
 
     from kme_tpu.bridge.broker import BrokerError
@@ -47,6 +86,9 @@ def consume_lines(broker, offset: int = 0, follow: bool = True,
             continue
         idle_since = time.monotonic()
         for r in recs:
+            if dedup is not None and dedup.is_dup(
+                    getattr(r, "epoch", None), getattr(r, "out_seq", None)):
+                continue
             yield f"{r.key} {r.value}"
         offset = recs[-1].offset + 1
 
@@ -58,17 +100,24 @@ def main(argv=None) -> int:
                    help="stop at the current end of MatchOut")
     p.add_argument("--idle-exit", type=float, default=None, metavar="SECS",
                    help="exit after this many seconds with no new records")
+    p.add_argument("--no-dedup", action="store_true",
+                   help="print replayed stamped records too (raw "
+                        "at-least-once view of the log)")
     args = p.parse_args(argv)
     from kme_tpu.bridge.tcp import TcpBroker, parse_addr
 
     host, port = parse_addr(args.broker)
     client = TcpBroker(host, port)
+    ring = None if args.no_dedup else DedupRing()
     try:
         for line in consume_lines(client, follow=not args.no_follow,
-                                  idle_exit=args.idle_exit):
+                                  idle_exit=args.idle_exit, dedup=ring):
             print(line, flush=True)
     except KeyboardInterrupt:
         pass
     finally:
         client.close()
+        if ring is not None and ring.suppressed:
+            print(f"kme-consume: suppressed {ring.suppressed} duplicate "
+                  f"record(s)", file=sys.stderr)
     return 0

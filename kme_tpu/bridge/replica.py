@@ -1,0 +1,385 @@
+"""kme-standby: hot-standby replica with bounded-failover promotion.
+
+The reference gets warm spares from Kafka Streams standby replicas
+(num.standby.replicas — state restored from changelogs on another
+instance, promoted by the group coordinator when the active dies). Here
+the same role is a second process sharing the leader's durable state
+root read-only:
+
+- it restores the NEWEST snapshot at startup (the ordinary resume path)
+  and then TAILS the leader's durable MatchIn topic log
+  (<checkpoint-dir>/broker-log/MatchIn.log) through _FollowBroker,
+  applying input through the same MatchService the leader runs — so its
+  engine state stays within one batch of the leader's;
+- application is BOUNDED by the leader's heartbeat offset
+  (serve.health) MINUS one batch: output the follower generates is
+  discarded but still COUNTED into the (epoch, out_seq) produce-stamp
+  cursor, and counting output the leader never confirmed would
+  desynchronize that cursor from the durable MatchOut log. The one-
+  batch holdback is deliberate: it keeps the follower's cursor STRICTLY
+  BEHIND the leader's durable output, so every promotion re-produces at
+  least the last confirmed batch — stamps the broker's idempotent-
+  produce watermark suppresses. Broker-side dedup is therefore
+  exercised on every real failover (dup_suppressed_total > 0 is an
+  invariant the chaos drill asserts, not a race), at the cost of
+  replaying at most one batch at promotion time;
+- when the supervisor detects leader death and the standby looks ready,
+  it writes <checkpoint-dir>/promote.json; the follower notices within
+  one poll, acquires the NEXT leader epoch, fences every predecessor at
+  the broker, reopens the durable topic logs as a real broker, binds
+  the leader's TCP endpoint and keeps serving from its applied offset.
+  The overlap between its applied offset and whatever the dead leader
+  already produced replays through the broker's idempotent-produce
+  watermark, which suppresses the duplicate stamps — the visible
+  MatchOut stream stays exactly-once across the failover.
+
+The old leader, should it still be alive (a stall, not a death), is
+FENCED: its next stamped produce carries a stale epoch and the broker
+rejects it (BrokerFenced -> kme-serve exits 75 -> its supervisor gives
+it a fresh epoch — but by then this replica owns the stream).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from kme_tpu import faults
+from kme_tpu.bridge.broker import (BrokerError, BrokerFenced,
+                                   InProcessBroker, Record)
+from kme_tpu.bridge.service import TOPIC_IN, MatchService
+
+PROMOTE_FILE = "promote.json"
+
+
+class _FollowBroker:
+    """Read-only broker facade over the leader's durable MatchIn log.
+
+    fetch() serves records parsed straight from the append-only JSONL
+    file, never past `limit` (the leader's last heartbeat offset — see
+    the module docstring for why running ahead is unsafe). produce() is
+    a counting discard: MatchService's follower mode only needs the
+    call to succeed so its out_seq cursor advances. A torn tail (the
+    leader died mid-append) is left unconsumed and re-read on the next
+    poll; a file that SHRANK (a fresh run reusing the directory) resets
+    the tail cursor entirely.
+    """
+
+    def __init__(self, log_dir: str, topic: str = TOPIC_IN) -> None:
+        self._path = os.path.join(log_dir, f"{topic}.log")
+        self._topic = topic
+        self._recs: List[Record] = []
+        self._pos = 0           # bytes of fully-parsed log lines
+        self.limit = 0          # leader-confirmed applied offset bound
+        self.discarded = 0      # produces swallowed while following
+
+    def _poll(self) -> None:
+        try:
+            with open(self._path, "rb") as f:
+                f.seek(self._pos)
+                data = f.read()
+        except OSError:
+            return              # leader has not created the topic yet
+        if not data:
+            with contextlib.suppress(OSError):
+                if os.path.getsize(self._path) < self._pos:
+                    self._recs, self._pos = [], 0   # truncated: re-read
+            return
+        consumed = 0
+        while True:
+            nl = data.find(b"\n", consumed)
+            if nl < 0:
+                break           # torn tail: retry once it completes
+            try:
+                row = json.loads(data[consumed:nl].decode("utf-8"))
+                if not isinstance(row, list) or len(row) not in (2, 4):
+                    raise ValueError("bad log row arity")
+            except (ValueError, UnicodeDecodeError):
+                break           # torn mid-file line: stop, re-read later
+            consumed = nl + 1
+            self._recs.append(Record(
+                len(self._recs), row[0], row[1],
+                row[2] if len(row) > 2 else None,
+                row[3] if len(row) > 3 else None))
+        self._pos += consumed
+
+    def fetch(self, topic: str, offset: int, max_records: int,
+              timeout: float = 0.0) -> List[Record]:
+        if topic != self._topic:
+            raise BrokerError(f"unknown topic {topic!r}")
+        self._poll()
+        end = min(len(self._recs), self.limit, offset + max_records)
+        recs = self._recs[offset:end]
+        if not recs and timeout > 0:
+            time.sleep(min(timeout, 0.1))
+        return recs
+
+    def end_offset(self, topic: str) -> int:
+        self._poll()
+        return len(self._recs)
+
+    def produce(self, topic: str, key, value) -> int:
+        self.discarded += 1
+        return -1
+
+
+class Replica:
+    """The follow -> promote state machine around one MatchService."""
+
+    def __init__(self, checkpoint_dir: str,
+                 listen: str = "127.0.0.1:9092",
+                 engine: str = "seq", compat: str = "fixed",
+                 batch: int = 1024, symbols: int = 1024,
+                 accounts: int = 4096, slots: int = 128,
+                 max_fills: int = 16, width: int = 8, shards: int = 1,
+                 checkpoint_every: int = 4096,
+                 checkpoint_keep: Optional[int] = None,
+                 max_lag: Optional[int] = None,
+                 promote_file: Optional[str] = None,
+                 health_file: Optional[str] = None,
+                 serve_health: Optional[str] = None,
+                 poll: float = 0.2, health_every: float = 1.0,
+                 max_messages: Optional[int] = None,
+                 idle_exit: Optional[float] = None) -> None:
+        self.checkpoint_dir = checkpoint_dir
+        self.listen = listen
+        self.max_lag = max_lag
+        self.poll = poll
+        self.health_every = health_every
+        self.health_file = health_file
+        self.max_messages = max_messages
+        self.idle_exit = idle_exit
+        self.promote_file = promote_file or os.path.join(
+            checkpoint_dir, PROMOTE_FILE)
+        self.serve_health = serve_health or os.path.join(
+            checkpoint_dir, "serve.health")
+        self.log_dir = os.path.join(checkpoint_dir, "broker-log")
+        self.holdback = max(1, batch)   # stay one batch behind (docstring)
+        self._ppid = os.getppid()   # orphan detection (follow loop)
+        self.follow = _FollowBroker(self.log_dir)
+        self.svc = MatchService(
+            self.follow, engine=engine, compat=compat, batch=batch,
+            symbols=symbols, accounts=accounts, slots=slots,
+            max_fills=max_fills, width=width, shards=shards,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            checkpoint_keep=checkpoint_keep,
+            exactly_once=True, follower=True)
+
+    # -- following ------------------------------------------------------
+
+    def _read_promote(self) -> Optional[dict]:
+        """The promotion order — only if addressed to THIS process (a
+        replacement standby spawned behind a promotion must never act
+        on, or delete, the adoptee's order). pid-less promote files are
+        honored for manual/test-driven promotion."""
+        try:
+            with open(self.promote_file) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return None
+        pid = data.get("pid")
+        if pid is not None and pid != os.getpid():
+            return None
+        return data
+
+    def _leader_offset(self) -> int:
+        """The leader's last confirmed applied offset — the follower
+        must never apply input beyond it (module docstring)."""
+        try:
+            with open(self.serve_health) as f:
+                hb = json.load(f)
+            if hb.get("role") == "leader":
+                return int(hb.get("offset", 0))
+        except (OSError, ValueError, TypeError):
+            pass
+        return 0
+
+    def _write_heartbeat(self, applied: int, tick: int) -> None:
+        if self.health_file is None:
+            return
+        tmp = self.health_file + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"pid": os.getpid(), "time": time.time(),
+                           "role": "standby", "applied": applied,
+                           "tick": tick,
+                           "out_seq": self.svc.out_seq,
+                           "discarded": self.follow.discarded}, f)
+            os.replace(tmp, self.health_file)
+        except OSError:
+            pass        # reporting surface only
+
+    def run(self) -> int:
+        svc = self.svc
+        print(f"kme-standby: following {self.log_dir} from offset "
+              f"{svc.offset} (out_seq {svc.out_seq})", file=sys.stderr)
+        tick = 0
+        last_hb = 0.0
+        while True:
+            promote = self._read_promote()
+            if promote is not None:
+                return self._promote(promote)
+            if os.getppid() != self._ppid:
+                # reparented: the supervisor that would ever promote us
+                # is gone — a follower with no path to leadership is an
+                # orphan, not a service
+                print("kme-standby: supervisor died; exiting",
+                      file=sys.stderr)
+                return 0
+            self.follow.limit = max(self.follow.limit,
+                                    self._leader_offset() - self.holdback)
+            n = svc.step(timeout=self.poll)
+            tick += 1
+            if n and faults.should("standby.lag", offset=svc.offset):
+                print(f"kme-faults: standby stalled at offset "
+                      f"{svc.offset}", file=sys.stderr)
+                time.sleep(1.0)
+            now = time.monotonic()
+            if now - last_hb >= self.health_every:
+                last_hb = now
+                self._write_heartbeat(svc.offset, tick)
+
+    # -- promotion ------------------------------------------------------
+
+    def _promote(self, promote: dict) -> int:
+        """Become the leader: next epoch, real broker over the durable
+        logs, the leader's TCP endpoint, and the ordinary serve loop.
+        The applied-offset .. dead-leader-output overlap replays through
+        the broker's idempotent-produce watermark (see module
+        docstring)."""
+        from kme_tpu.bridge.provision import provision
+        from kme_tpu.bridge.tcp import parse_addr, serve_broker
+
+        svc = self.svc
+        with contextlib.suppress(OSError):
+            os.unlink(self.promote_file)
+        broker = InProcessBroker(persist_dir=self.log_dir,
+                                 max_lag=self.max_lag)
+        provision(broker)       # idempotent; logs already reloaded
+        host, port = parse_addr(self.listen)
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                # the dead leader's socket may linger in TIME_WAIT for
+                # a moment even with SO_REUSEADDR; retry briefly
+                srv, broker = serve_broker(host, port, broker)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
+        svc.broker = broker
+        svc.follower = False
+        svc._init_exactly_once(resumed=False)   # next epoch + fence
+        failover = None
+        try:
+            failed_at = float(promote["failed_at"])
+            failover = round(max(0.0, time.time() - failed_at), 3)
+            svc.telemetry.gauge("failover_seconds").set(failover)
+        except (KeyError, TypeError, ValueError):
+            pass
+        print(f"kme-standby: PROMOTED to leader epoch {svc.epoch} at "
+              f"offset {svc.offset} (out_seq {svc.out_seq}, "
+              f"failover {failover if failover is not None else '?'}s)",
+              file=sys.stderr)
+        try:
+            seen = svc.run(max_messages=self.max_messages,
+                           idle_exit=self.idle_exit,
+                           health_file=self.serve_health,
+                           health_every=self.health_every)
+            svc.checkpoint()
+            print(f"kme-standby: processed {seen} records as leader",
+                  file=sys.stderr)
+            return 0
+        finally:
+            svc.close()
+            srv.shutdown()
+            if hasattr(broker, "close"):
+                broker.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kme-standby", description=__doc__,
+                                formatter_class=argparse.
+                                RawDescriptionHelpFormatter)
+    p.add_argument("--checkpoint-dir", required=True,
+                   help="the LEADER's state root (snapshots, broker "
+                        "logs, lease, promote file) — shared read-only "
+                        "until promotion")
+    p.add_argument("--listen", default="127.0.0.1:9092",
+                   metavar="HOST:PORT",
+                   help="the leader's broker endpoint, bound at "
+                        "promotion")
+    p.add_argument("--engine", choices=("seq", "lanes", "oracle",
+                                        "native"), default="seq")
+    p.add_argument("--compat", choices=("java", "fixed"),
+                   default="fixed")
+    p.add_argument("--batch", type=int, default=1024)
+    p.add_argument("--symbols", type=int, default=1024)
+    p.add_argument("--accounts", type=int, default=4096)
+    p.add_argument("--slots", type=int, default=128)
+    p.add_argument("--max-fills", type=int, default=16)
+    p.add_argument("--width", type=int, default=8)
+    p.add_argument("--shards", type=int, default=1)
+    p.add_argument("--checkpoint-every", type=int, default=4096)
+    p.add_argument("--checkpoint-keep", type=int, default=None)
+    p.add_argument("--max-lag", type=int, default=None)
+    p.add_argument("--idle-exit", type=float, default=None,
+                   help="applies AFTER promotion (a follower waits "
+                        "indefinitely)")
+    p.add_argument("--max-messages", type=int, default=None)
+    p.add_argument("--health-file", default=None, metavar="PATH",
+                   help="standby heartbeat JSON ({pid, time, role, "
+                        "applied, tick}); the supervisor requires it "
+                        "before promoting")
+    p.add_argument("--health-every", type=float, default=1.0)
+    p.add_argument("--promote-file", default=None, metavar="PATH",
+                   help="promotion trigger written by kme-supervise "
+                        "(default <checkpoint-dir>/promote.json)")
+    p.add_argument("--serve-health-file", default=None, metavar="PATH",
+                   help="the LEADER's heartbeat to bound application "
+                        "by (default <checkpoint-dir>/serve.health); "
+                        "reused as this process's own heartbeat after "
+                        "promotion")
+    p.add_argument("--poll", type=float, default=0.2,
+                   help="follow-loop poll interval (also the promote-"
+                        "file detection latency bound)")
+    args, unknown = p.parse_known_args(argv)
+    if unknown:
+        # the supervisor forwards the leader's serve_args verbatim;
+        # serve-only flags (journal, metrics, strict, ...) don't apply
+        # to a follower and are ignored loudly rather than fatally
+        print(f"kme-standby: ignoring serve-only flag(s): "
+              f"{' '.join(unknown)}", file=sys.stderr)
+    rep = Replica(args.checkpoint_dir, listen=args.listen,
+                  engine=args.engine, compat=args.compat,
+                  batch=args.batch, symbols=args.symbols,
+                  accounts=args.accounts, slots=args.slots,
+                  max_fills=args.max_fills, width=args.width,
+                  shards=args.shards,
+                  checkpoint_every=args.checkpoint_every,
+                  checkpoint_keep=args.checkpoint_keep,
+                  max_lag=args.max_lag,
+                  promote_file=args.promote_file,
+                  health_file=args.health_file,
+                  serve_health=args.serve_health_file,
+                  poll=args.poll, health_every=args.health_every,
+                  max_messages=args.max_messages,
+                  idle_exit=args.idle_exit)
+    try:
+        return rep.run()
+    except BrokerFenced as e:
+        print(f"kme-standby: FENCED: {e}", file=sys.stderr)
+        return 75
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,18 @@ def main(argv=None) -> int:
                    help="batch = fsync the journal after every batch "
                         "(bounds loss to one batch); off = OS "
                         "buffering, flushed at checkpoints and exit")
+    p.add_argument("--journal-keep", type=int, default=None, metavar="N",
+                   help="retain at most N rotated journal segments — "
+                        "but NEVER prune one newer than the oldest "
+                        "retained snapshot (a standby restoring it "
+                        "must still replay to the tip)")
+    p.add_argument("--at-least-once", action="store_true",
+                   help="disable the exactly-once output path (leader "
+                        "epoch + fenced idempotent produce stamps) "
+                        "that is otherwise on whenever "
+                        "--checkpoint-dir is set: replayed post-"
+                        "snapshot tails land on MatchOut again instead "
+                        "of being suppressed broker-side")
     p.add_argument("--audit", action="store_true",
                    help="run the continuous invariant auditor in-"
                         "process: a shadow ledger replays the journal "
@@ -145,6 +157,20 @@ def main(argv=None) -> int:
               file=sys.stderr)
     if args.auto_provision:
         provision(broker)
+    # exactly-once is the DEFAULT served contract once durability is on
+    # (the reference shipped with it commented out, KProcessor.java:29);
+    # --at-least-once opts back into the historical behavior. The Kafka
+    # transport has no produce stamps and REJ annotations interleave at
+    # non-deterministic batch boundaries — both fall back loudly.
+    exactly_once = (args.checkpoint_dir is not None
+                    and args.kafka is None
+                    and not args.at_least_once)
+    if exactly_once and args.annotate_rejects:
+        print("kme-serve: --annotate-rejects interleaves REJ records at "
+              "batch boundaries, which replay differently across a "
+              "resume; falling back to at-least-once output",
+              file=sys.stderr)
+        exactly_once = False
     tracer = None
     if args.trace_out is not None:
         from kme_tpu.telemetry import TraceRecorder, install
@@ -162,9 +188,11 @@ def main(argv=None) -> int:
                        journal=args.journal_out,
                        journal_rotate_mb=args.journal_rotate_mb,
                        journal_fsync=args.journal_fsync,
+                       journal_keep=args.journal_keep,
                        audit=args.audit,
                        audit_repro_dir=args.audit_repro_dir,
-                       annotate_rejects=args.annotate_rejects)
+                       annotate_rejects=args.annotate_rejects,
+                       exactly_once=exactly_once)
     msrv = None
     if args.metrics_port is not None:
         from kme_tpu.telemetry import start_metrics_server
@@ -173,6 +201,9 @@ def main(argv=None) -> int:
         print(f"kme-serve: metrics on "
               f"http://{msrv.server_address[0]}:"
               f"{msrv.server_address[1]}/metrics", file=sys.stderr)
+    rc = 0
+    from kme_tpu.bridge.broker import BrokerFenced
+
     try:
         seen = svc.run(max_messages=args.max_messages,
                        idle_exit=args.idle_exit,
@@ -186,6 +217,13 @@ def main(argv=None) -> int:
             import json
 
             print(f"kme-serve: metrics {json.dumps(met)}", file=sys.stderr)
+    except BrokerFenced as e:
+        # a newer leader epoch owns the stream (failover promotion or a
+        # lease steal): nothing this incarnation could write will ever
+        # be visible. Exit 75 (EX_TEMPFAIL) — the supervisor restarts
+        # us and the fresh incarnation acquires the NEXT epoch.
+        print(f"kme-serve: FENCED: {e}", file=sys.stderr)
+        rc = 75
     except KeyboardInterrupt:
         pass
     finally:
@@ -204,7 +242,7 @@ def main(argv=None) -> int:
             srv.shutdown()
         if hasattr(broker, "close"):
             broker.close()
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

@@ -21,6 +21,19 @@ Malformed values (JSON Jackson would reject) kill the reference's
 stream thread (KProcessor.java:513-517); the service instead drops the
 record with a stderr note — a deliberate fix, flagged by `strict=True`
 which replicates the reference behavior by raising.
+
+Output contract: by default AT-LEAST-ONCE (the reference, with Kafka's
+exactly-once commented out at KProcessor.java:29 — crash + resume
+replays the post-snapshot tail). `exactly_once=True` upgrades that to
+exactly-once VISIBLE output: the service acquires a leader epoch
+(bridge/lease.py), stamps every MatchOut produce with
+`(epoch, out_seq)` (wire.ProduceStamp), and the broker fences stale
+epochs and suppresses replayed stamps (bridge/broker.py), so the
+durable MatchOut log itself carries each record exactly once.
+`follower=True` runs the service as a hot-standby replica: produces are
+discarded (but out_seq still counts them, so a promotion can continue
+the stamp stream), checkpoints are skipped, and no lease is held until
+promotion (bridge/replica.py).
 """
 
 from __future__ import annotations
@@ -46,9 +59,12 @@ class MatchService:
                  checkpoint_keep: Optional[int] = None,
                  journal=None, journal_rotate_mb: Optional[int] = None,
                  journal_fsync: str = "off",
+                 journal_keep: Optional[int] = None,
                  audit: bool = False,
                  audit_repro_dir: Optional[str] = None,
-                 annotate_rejects: bool = False) -> None:
+                 annotate_rejects: bool = False,
+                 exactly_once: bool = False,
+                 follower: bool = False) -> None:
         if engine not in ("lanes", "seq", "oracle", "native"):
             raise ValueError(f"unknown engine {engine!r}")
         if compat not in ("java", "fixed"):
@@ -77,14 +93,31 @@ class MatchService:
         self._journal_arg = journal
         self._journal_rotate_mb = journal_rotate_mb
         self._journal_fsync = journal_fsync
+        self._journal_keep = journal_keep
         self._audit_arg = audit
         self._audit_repro_dir = audit_repro_dir
         self.annotate_rejects = annotate_rejects
+        self.exactly_once = exactly_once
+        self.follower = follower
+        self.epoch: Optional[int] = None  # leader fencing token
+        self.out_seq = 0                  # next MatchOut produce stamp
+        if exactly_once and checkpoint_dir is None:
+            raise ValueError("exactly_once needs checkpoint_dir (the "
+                             "leader-epoch lease lives there)")
+        if exactly_once and annotate_rejects:
+            # REJ annotations interleave at BATCH boundaries, and batch
+            # boundaries are not deterministic across a resume — the
+            # out_seq stamp stream would diverge from the original and
+            # the broker would dedup the wrong records
+            raise ValueError("exactly_once is incompatible with "
+                             "annotate_rejects (REJ records interleave "
+                             "at non-deterministic batch boundaries)")
         self.degraded = None        # set by the invariant auditor
         resumed = False
         if checkpoint_dir is not None:
             resumed = self._try_resume(engine, compat, shards, width)
         if resumed:
+            self._init_exactly_once(resumed=True)
             self._init_telemetry()
             self._init_observability(resumed=True)
             self._commit_watermark()
@@ -114,9 +147,55 @@ class MatchService:
             self._oracle = OracleEngine(compat, **kw)
         else:
             raise ValueError(f"unknown engine {engine!r}")
+        self._init_exactly_once(resumed=False)
         self._init_telemetry()
         self._init_observability(resumed=False)
         self._commit_watermark()
+
+    def _init_exactly_once(self, resumed: bool) -> None:
+        """Exactly-once startup: restore the produce-stamp cursor from
+        the snapshot's extra meta, then (leaders only) acquire the next
+        leader epoch and fence every predecessor at the broker. The
+        explicit fence matters: a promoted/restarted broker reload only
+        learns PRIOR epochs from the log stamps, so without it a zombie
+        old leader holding the previous epoch would still get through.
+        A follower restores the cursor but holds no lease — its
+        produces are discarded until promotion
+        (bridge/replica.py)."""
+        if not self.exactly_once:
+            return
+        if resumed:
+            from kme_tpu.runtime import checkpoint as ck
+
+            extra = ck.snapshot_extra(self.checkpoint_dir, self.offset)
+            try:
+                self.out_seq = int(extra.get("out_seq", 0))
+            except (TypeError, ValueError):
+                self.out_seq = 0
+        if self.follower:
+            return
+        import inspect
+
+        from kme_tpu.bridge import lease
+
+        try:
+            params = inspect.signature(self.broker.produce).parameters
+        except (TypeError, ValueError):
+            params = {}
+        if "out_seq" not in params:
+            # e.g. the Kafka transport: no produce stamps, no fencing —
+            # fall back loudly to the at-least-once contract
+            print("kme-serve: broker transport has no produce stamps; "
+                  "exactly-once disabled (at-least-once output)",
+                  file=sys.stderr)
+            self.exactly_once = False
+            return
+        self.epoch = lease.acquire(self.checkpoint_dir)
+        fence = getattr(self.broker, "fence", None)
+        if fence is not None:
+            fence(self.epoch)
+        print(f"kme-serve: leader epoch {self.epoch} (out_seq resumes "
+              f"at {self.out_seq})", file=sys.stderr)
 
     def _commit_watermark(self) -> None:
         """Advance the broker's consumer watermark for MatchIn — this
@@ -150,7 +229,21 @@ class MatchService:
         if isinstance(j, str):
             rb = (self._journal_rotate_mb * (1 << 20)
                   if self._journal_rotate_mb else None)
-            j = Journal(j, rotate_bytes=rb, fsync=self._journal_fsync)
+            guard = None
+            if self.checkpoint_dir is not None:
+                # retention coupling: rotated journal segments may only
+                # be pruned once every event in them is older than the
+                # oldest retained snapshot — a standby restoring that
+                # snapshot must still replay to the tip
+                ckpt_dir = self.checkpoint_dir
+
+                def guard():
+                    from kme_tpu.runtime import checkpoint as ck
+
+                    return ck.oldest_retained_offset(ckpt_dir)
+            j = Journal(j, rotate_bytes=rb, fsync=self._journal_fsync,
+                        rotate_keep=self._journal_keep,
+                        retention_guard=guard)
         self.journal = j
         if j is not None and resumed:
             j.rewind_to_offset(self.offset)
@@ -332,7 +425,9 @@ class MatchService:
                 f"requested")
 
     def _maybe_checkpoint(self) -> None:
-        if self.checkpoint_dir is None:
+        if self.checkpoint_dir is None or self.follower:
+            # a follower shares the leader's checkpoint dir read-only:
+            # writing snapshots from two processes would race the prune
             return
         if self.offset - self._last_ckpt_offset < self.checkpoint_every:
             return
@@ -358,21 +453,47 @@ class MatchService:
                 print(f"kme-serve: broker sync failed before checkpoint "
                       f"({e}); snapshot deferred", file=sys.stderr)
                 return
+        extra = None
+        if self.epoch is not None:
+            from kme_tpu.bridge import lease
+            from kme_tpu.bridge.broker import BrokerFenced
+
+            if faults.should("lease.steal", offset=self.offset):
+                # split-brain drill: another incarnation grabs the next
+                # epoch (and, like any real new leader, fences us at
+                # the broker)
+                stolen = lease.steal(self.checkpoint_dir)
+                fence = getattr(self.broker, "fence", None)
+                if fence is not None:
+                    fence(stolen)
+                print(f"kme-faults: lease stolen (epoch {stolen}) at "
+                      f"offset {self.offset}", file=sys.stderr)
+            cur = lease.current_epoch(self.checkpoint_dir)
+            if cur > self.epoch:
+                # self-fence before writing anything: a newer leader
+                # owns the stream; our snapshot would roll ITS state
+                # machine back
+                raise BrokerFenced(
+                    f"fenced: leader epoch {self.epoch} superseded by "
+                    f"{cur}; refusing to checkpoint")
+            extra = {"epoch": self.epoch, "out_seq": self.out_seq}
         if self._session is not None:
             from kme_tpu.runtime.seqsession import SeqSession
 
             if isinstance(self._session, SeqSession):
                 ck.save_seq_session(self.checkpoint_dir, self._session,
-                                    self.offset, keep=self.checkpoint_keep)
+                                    self.offset, keep=self.checkpoint_keep,
+                                    extra=extra)
             else:
                 ck.save_session(self.checkpoint_dir, self._session,
-                                self.offset, keep=self.checkpoint_keep)
+                                self.offset, keep=self.checkpoint_keep,
+                                extra=extra)
         elif self._native is not None:
             ck.save_native(self.checkpoint_dir, self._native, self.offset,
-                           keep=self.checkpoint_keep)
+                           keep=self.checkpoint_keep, extra=extra)
         else:
             ck.save_oracle(self.checkpoint_dir, self._oracle, self.offset,
-                           keep=self.checkpoint_keep)
+                           keep=self.checkpoint_keep, extra=extra)
         self._last_ckpt_offset = self.offset
         if self.journal is not None:
             # the journal is best-effort relative to the broker log, but
@@ -476,8 +597,11 @@ class MatchService:
         self.offset = recs[-1].offset + 1
         # crash window the chaos harness targets: outputs are on
         # MatchOut but the snapshot has not caught up — recovery MUST
-        # replay from the last checkpoint and reproduce these bytes
-        faults.kill_now("serve.kill", offset=self.offset)
+        # replay from the last checkpoint and reproduce these bytes.
+        # (Leader-only: a follower tails the raw input log and can run
+        # ahead of the leader, so it must not consume the kill budget.)
+        if not self.follower:
+            faults.kill_now("serve.kill", offset=self.offset)
         self._maybe_checkpoint()
         self._commit_watermark()
         self._publish_batch(len(recs), len(recs) - len(msgs))
@@ -500,29 +624,59 @@ class MatchService:
         shed = getattr(self.broker, "overload_rejects", None)
         if shed is not None:
             t.gauge("overload_rejects").set(shed)
+        self._publish_eos_gauges()
         now = time.monotonic()
         if self._session is not None and now - self._last_engine_pub >= 1.0:
             self._last_engine_pub = now
             self._session.metrics()      # publishes counters + gauges
             self._session.histograms()   # publishes bucket counts
 
-    def _produce_retry(self, topic: str, key, value) -> None:
+    def _publish_eos_gauges(self) -> None:
+        """Exactly-once observability (cheap broker-attribute reads;
+        safe from the heartbeat thread too)."""
+        t = self.telemetry
+        for name, attr in (("dup_suppressed_total", "dup_suppressed"),
+                           ("fenced_produces_total", "fenced_produces")):
+            v = getattr(self.broker, attr, None)
+            if v is not None:
+                t.gauge(name).set(v)
+        if self.epoch is not None:
+            t.gauge("leader_epoch").set(self.epoch)
+
+    def _produce_retry(self, topic: str, key, value,
+                       stamp: bool = False) -> None:
         """Produce with bounded exponential backoff. A transport blip
         (socket reset, injected broker.produce fault) must not kill the
         serve loop mid-batch: the offset has NOT advanced yet, so a
         retry is safe — at worst the record lands twice, which the
-        at-least-once contract already allows. Gives up (re-raises)
-        after the attempts are exhausted so a genuinely dead broker
-        still fails loudly for the supervisor."""
+        at-least-once contract allows and the exactly-once stamp path
+        dedups broker-side. `stamp=True` marks an output-stream record:
+        a leader sends it with its `(epoch, out_seq)` stamp; a follower
+        only COUNTS it (the discarded produce keeps the cursor aligned
+        for promotion). BrokerFenced is never retried — a newer leader
+        owns the stream and this process must die so its supervisor
+        restarts it under a fresh epoch."""
         import time
 
-        from kme_tpu.bridge.broker import BrokerError
+        from kme_tpu.bridge.broker import BrokerError, BrokerFenced
 
+        stamped = stamp and self.epoch is not None
+        counted = stamp and (stamped
+                             or (self.follower and self.exactly_once))
         delay = 0.05
         for attempt in range(6):
             try:
-                self.broker.produce(topic, key, value)
+                if stamped:
+                    self.broker.produce(topic, key, value,
+                                        epoch=self.epoch,
+                                        out_seq=self.out_seq)
+                else:
+                    self.broker.produce(topic, key, value)
+                if counted:
+                    self.out_seq += 1
                 return
+            except BrokerFenced:
+                raise
             except BrokerError as e:
                 if attempt == 5:
                     raise
@@ -537,7 +691,7 @@ class MatchService:
         for lines in out:
             for ln in lines:
                 key, _, value = ln.partition(" ")
-                self._produce_retry(TOPIC_OUT, key, value)
+                self._produce_retry(TOPIC_OUT, key, value, stamp=True)
 
     def _native_produce(self, msgs):
         # byte-faithful death handling: forward every completed
@@ -669,7 +823,9 @@ class MatchService:
                     open(stall_once, "w").close()
                     while True:   # frozen tick, live heartbeat thread
                         time.sleep(0.5)
-                if n and faults.should("serve.stuck", offset=self.offset):
+                if n and not self.follower \
+                        and faults.should("serve.stuck",
+                                          offset=self.offset):
                     # stuck step(): the loop tick freezes while the
                     # heartbeat thread keeps the mtime fresh — exactly
                     # the hang shape the supervisor's stall branch
@@ -681,24 +837,35 @@ class MatchService:
         finally:
             if beat_stop is not None:
                 beat_stop.set()
-                self._write_heartbeat(health_file, seen, tick_box[0])
+                self._write_heartbeat(health_file, seen, tick_box[0],
+                                      closing=True)
         return seen
 
     def _write_heartbeat(self, path: str, seen: int,
-                         tick: int = 0) -> None:
+                         tick: int = 0, closing: bool = False) -> None:
         import json
         import os
         import time as _t
 
+        # refresh broker-side exactly-once counters HERE, not only on
+        # the batch path: the final heartbeat after run() drains must
+        # capture post-batch suppressions/fences
+        self._publish_eos_gauges()
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             # "metrics" is ADDITIVE — the supervisor keys
             # (pid/time/seen/offset/tick) are load-bearing
             # (tests/test_supervise.py). snapshot() only takes the
             # registry lock; safe from this background thread.
+            # "closing" tells the supervisor the serve loop ended on
+            # purpose (idle-exit / max-messages): the tick is frozen by
+            # definition, so the stall detector must stand down while
+            # the final checkpoint + teardown run.
             json.dump({"pid": os.getpid(), "time": _t.time(),
                        "seen": seen, "offset": self.offset,
-                       "tick": tick,
+                       "tick": tick, "closing": closing,
                        "degraded": self.degraded,
+                       "role": "follower" if self.follower else "leader",
+                       "epoch": self.epoch,
                        "metrics": self.telemetry.snapshot()}, f)
         os.replace(tmp, path)

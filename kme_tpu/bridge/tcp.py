@@ -14,12 +14,19 @@ object per line — carrying the three broker operations:
   {"op":"end_offset","topic":T}                   -> {"ok":true,"offset":N}
   {"op":"commit","topic":T,"offset":N}            -> {"ok":true}
   {"op":"sync"}                                   -> {"ok":true}
+  {"op":"fence","epoch":E}                        -> {"ok":true}
+
+Exactly-once produces additionally carry "epoch" and "out_seq" keys
+(optional — absent means the unstamped at-least-once path); fetch rows
+for stamped records come back as [o,k,v,epoch,out_seq].
 
 Errors come back as {"ok":false,"error":"..."}; the client raises
 BrokerError (BrokerOverload when the reply carries
-"code":"rej_overload" — the bounded-ingress shed). `serve_broker` hosts an InProcessBroker for any number of
-concurrent client connections (thread per connection — the broker core
-is already thread-safe).
+"code":"rej_overload" — the bounded-ingress shed; BrokerFenced for
+"code":"fenced" — a stale-epoch produce, which callers must treat as
+fatal, not retryable). `serve_broker` hosts an InProcessBroker for any
+number of concurrent client connections (thread per connection — the
+broker core is already thread-safe).
 """
 
 from __future__ import annotations
@@ -31,8 +38,9 @@ import threading
 from typing import List, Optional
 
 from kme_tpu import faults
-from kme_tpu.bridge.broker import (BrokerError, BrokerOverload,
-                                   InProcessBroker, Record)
+from kme_tpu.bridge.broker import (BrokerError, BrokerFenced,
+                                   BrokerOverload, InProcessBroker,
+                                   Record)
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -50,14 +58,19 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = {"ok": True, "topics": broker.topics()}
                 elif op == "produce":
                     off = broker.produce(req["topic"], req.get("key"),
-                                         req["value"])
+                                         req["value"],
+                                         epoch=req.get("epoch"),
+                                         out_seq=req.get("out_seq"))
                     resp = {"ok": True, "offset": off}
                 elif op == "produce_batch":
                     # one round trip for a whole record batch — the bulk
                     # seeding path (kme-loadgen)
                     off = -1
-                    for key, value in req["records"]:
-                        off = broker.produce(req["topic"], key, value)
+                    for rec in req["records"]:
+                        off = broker.produce(
+                            req["topic"], rec[0], rec[1],
+                            epoch=rec[2] if len(rec) > 2 else None,
+                            out_seq=rec[3] if len(rec) > 3 else None)
                     resp = {"ok": True, "last_offset": off}
                 elif op == "fetch":
                     recs = broker.fetch(
@@ -65,8 +78,15 @@ class _Handler(socketserver.StreamRequestHandler):
                         int(req.get("max", 1024)),
                         float(req.get("timeout_ms", 0)) / 1e3)
                     resp = {"ok": True,
-                            "records": [[r.offset, r.key, r.value]
-                                        for r in recs]}
+                            "records": [
+                                [r.offset, r.key, r.value]
+                                if r.epoch is None and r.out_seq is None
+                                else [r.offset, r.key, r.value,
+                                      r.epoch, r.out_seq]
+                                for r in recs]}
+                elif op == "fence":
+                    broker.fence(int(req["epoch"]))
+                    resp = {"ok": True}
                 elif op == "end_offset":
                     resp = {"ok": True,
                             "offset": broker.end_offset(req["topic"])}
@@ -78,7 +98,7 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = {"ok": True}
                 else:
                     resp = {"ok": False, "error": f"unknown op {op!r}"}
-            except BrokerOverload as e:
+            except (BrokerOverload, BrokerFenced) as e:
                 resp = {"ok": False, "error": str(e), "code": e.code}
             except BrokerError as e:
                 resp = {"ok": False, "error": str(e)}
@@ -183,6 +203,8 @@ class TcpBroker:
             err = resp.get("error", "unknown broker error")
             if resp.get("code") == BrokerOverload.code:
                 raise BrokerOverload(err)
+            if resp.get("code") == BrokerFenced.code:
+                raise BrokerFenced(err)
             raise BrokerError(err)
         return resp
 
@@ -193,9 +215,15 @@ class TcpBroker:
     def topics(self) -> dict:
         return self._call({"op": "topics"})["topics"]
 
-    def produce(self, topic: str, key: Optional[str], value: str) -> int:
-        return self._call({"op": "produce", "topic": topic, "key": key,
-                           "value": value})["offset"]
+    def produce(self, topic: str, key: Optional[str], value: str,
+                epoch: Optional[int] = None,
+                out_seq: Optional[int] = None) -> int:
+        req = {"op": "produce", "topic": topic, "key": key, "value": value}
+        if epoch is not None:
+            req["epoch"] = epoch
+        if out_seq is not None:
+            req["out_seq"] = out_seq
+        return self._call(req)["offset"]
 
     def produce_batch(self, topic: str, records) -> int:
         """Append [(key, value), ...] in one round trip; returns the last
@@ -208,7 +236,10 @@ class TcpBroker:
         resp = self._call({"op": "fetch", "topic": topic, "offset": offset,
                            "max": max_records, "timeout_ms": timeout * 1e3},
                           extra_wait=timeout)
-        return [Record(o, k, v) for o, k, v in resp["records"]]
+        return [Record(row[0], row[1], row[2],
+                       row[3] if len(row) > 3 else None,
+                       row[4] if len(row) > 4 else None)
+                for row in resp["records"]]
 
     def end_offset(self, topic: str) -> int:
         return self._call({"op": "end_offset", "topic": topic})["offset"]
@@ -221,6 +252,11 @@ class TcpBroker:
     def sync(self) -> None:
         """fsync the broker's topic logs (see InProcessBroker.sync)."""
         self._call({"op": "sync"})
+
+    def fence(self, epoch: int) -> None:
+        """Fence every producer epoch below `epoch` (see
+        InProcessBroker.fence)."""
+        self._call({"op": "fence", "epoch": int(epoch)})
 
 
 def parse_addr(addr: str) -> tuple:

@@ -344,6 +344,17 @@ def supervise_main(argv=None) -> int:
     return _main(argv)
 
 
+def standby_main(argv=None) -> int:
+    """Hot-standby replica: tail the leader's durable input, stay one
+    batch behind, take over (next leader epoch, old one fenced) when
+    kme-supervise writes the promote file."""
+    try:
+        from kme_tpu.bridge.replica import main as _main
+    except ImportError:
+        return _not_yet("the hot-standby replica")
+    return _main(argv)
+
+
 def chaos_main(argv=None) -> int:
     """Deterministic fault-injection runs (kme-supervise + KME_FAULTS)
     with byte-exact MatchOut verification against the oracle."""
@@ -358,15 +369,15 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m kme_tpu.cli")
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
-        "supervise", "trace", "chaos"))
+        "supervise", "standby", "trace", "chaos"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
             "loadgen": loadgen_main, "oracle": oracle_main,
             "bench": bench_main, "serve": serve_main,
             "consume": consume_main, "provision": provision_main,
-            "supervise": supervise_main, "trace": trace_main,
-            "chaos": chaos_main,
+            "supervise": supervise_main, "standby": standby_main,
+            "trace": trace_main, "chaos": chaos_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

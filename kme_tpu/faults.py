@@ -39,6 +39,12 @@ Known injection points (the call sites document themselves; grep for
     serve.kill       SIGKILL the serve process at an input offset
     serve.stuck      freeze the serve loop (tick stops, heartbeat
                      thread lives) at an input offset
+    lease.steal      split-brain drill: another incarnation steals the
+                     leader lease (next epoch + broker fence) right
+                     before a checkpoint — the current leader must
+                     detect it and die fenced, never write
+    standby.lag      stall the hot-standby follower mid-tail (the
+                     promotion path must absorb the catch-up)
 
 Cross-process accounting: under a supervisor, a restarted child re-reads
 the same KME_FAULTS — an ``n``-limited rule must not refire every
@@ -64,7 +70,7 @@ ENV_STATE = "KME_FAULTS_STATE"
 
 _POINTS = ("broker.produce", "broker.fetch", "tcp.partial",
            "tcp.disconnect", "ckpt.torn", "ckpt.bitflip", "journal.torn",
-           "serve.kill", "serve.stuck")
+           "serve.kill", "serve.stuck", "lease.steal", "standby.lag")
 
 
 class FaultSpecError(ValueError):

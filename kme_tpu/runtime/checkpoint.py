@@ -13,6 +13,12 @@ deterministic, resume = load snapshot + replay the input tail, and the
 replayed outputs are bit-identical — the same at-least-once contract
 with replay bounded by the checkpoint interval instead of one record.
 
+The exactly-once layer (bridge/broker.py fencing + idempotent produce)
+upgrades that: every save accepts an additive ``extra`` meta dict — the
+service stores its ``{"epoch", "out_seq"}`` produce-stamp cursor there —
+and `snapshot_extra` reads it back on resume, so the replayed tail
+re-produces with the SAME stamps and the broker suppresses it.
+
 Snapshots are self-describing single files: every state array plus a
 JSON `meta` blob (config, compaction width, shard count, input offset,
 scheduler id-maps) in one .npz, written atomically (tmp + rename) and
@@ -133,7 +139,8 @@ def list_snapshots(ckpt_dir: str) -> List[Tuple[int, str]]:
 
 
 def save_session(ckpt_dir: str, session, offset: int,
-                 keep: Optional[int] = None) -> str:
+                 keep: Optional[int] = None,
+                 extra: Optional[dict] = None) -> str:
     """Snapshot `session` (a LaneSession) at input offset `offset`.
     Must be called at a batch boundary (the fill log drained)."""
     import jax
@@ -156,6 +163,8 @@ def save_session(ckpt_dir: str, session, offset: int,
         "oid_sid": sorted(sch.oid_sid.items()),
         "rr_lane": sch._rr_lane,
     }
+    if extra:
+        meta["extra"] = dict(extra)
     S = session.cfg.lanes  # canonical lane count (no scrap row)
     A = session.cfg.accounts
     payload = {}
@@ -358,7 +367,8 @@ def _restore_one(path: str, shards: Optional[int], width: Optional[int]):
 
 
 def save_seq_session(ckpt_dir: str, session, offset: int,
-                     keep: Optional[int] = None) -> str:
+                     keep: Optional[int] = None,
+                     extra: Optional[dict] = None) -> str:
     """Snapshot a SeqSession at input offset `offset` in the SAME
     canonical layout as lanes snapshots (slot_* / flat s64 positions /
     bal), so snapshots restore across ENGINES as well as across
@@ -366,7 +376,8 @@ def save_seq_session(ckpt_dir: str, session, offset: int,
     from kme_tpu.engine import seq as SQ
 
     if session.cfg.compat == "java":
-        return _save_seqjava(ckpt_dir, session, offset, keep=keep)
+        return _save_seqjava(ckpt_dir, session, offset, keep=keep,
+                             extra=extra)
     os.makedirs(ckpt_dir, exist_ok=True)
     canon = SQ.export_canonical(session.cfg, session.state)
     r = session.router
@@ -384,6 +395,8 @@ def save_seq_session(ckpt_dir: str, session, offset: int,
         "width": 0,
         "shards": 1,
     }
+    if extra:
+        meta["extra"] = dict(extra)
     payload = {k: v for k, v in canon.items()
                if k != "metrics" and v is not None}
     payload["err"] = np.asarray(canon["err"])
@@ -395,7 +408,8 @@ def save_seq_session(ckpt_dir: str, session, offset: int,
 
 
 def _save_seqjava(ckpt_dir: str, session, offset: int,
-                  keep: Optional[int] = None) -> str:
+                  keep: Optional[int] = None,
+                  extra: Optional[dict] = None) -> str:
     """Snapshot a java-mode SeqSession: the canonical java form
     (runtime/javasnap.py) — flat 128-bit-key position arrays (Q11
     garbage keys included: they are parity-relevant state), resting
@@ -416,6 +430,8 @@ def _save_seqjava(ckpt_dir: str, session, offset: int,
         "sid_lane": sorted(snap["sid_lane"].items()),
         "oid_sid": sorted(snap["oid_sid"].items()),
     }
+    if extra:
+        meta["extra"] = dict(extra)
     payload = {k: np.asarray(v) for k, v in snap.items()
                if k not in ("aid_idx", "sid_lane", "oid_sid")}
     payload["meta"] = np.frombuffer(
@@ -541,17 +557,21 @@ def _restore_seq_one(path: str, cfg):
 # native-engine snapshots (text store dump + a JSON header line)
 
 def save_native(ckpt_dir: str, engine, offset: int,
-                keep: Optional[int] = None) -> str:
+                keep: Optional[int] = None,
+                extra: Optional[dict] = None) -> str:
     """Snapshot a NativeOracleEngine: JSON header (compat + envelope +
     offset + dump digest) on line one, then the store dump."""
     os.makedirs(ckpt_dir, exist_ok=True)
     dump = engine.dump_state()
-    header = json.dumps({
+    head = {
         "version": 1, "kind": "native", "offset": int(offset),
         "compat": "java" if engine.java else "fixed",
         "book_slots": engine.book_slots, "max_fills": engine.max_fills,
         "digest": hashlib.sha256(dump.encode("utf-8")).hexdigest(),
-    })
+    }
+    if extra:
+        head["extra"] = dict(extra)
+    header = json.dumps(head)
     path = os.path.join(ckpt_dir, f"ckpt-{offset}.nat")
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
@@ -610,7 +630,8 @@ def load_native(ckpt_dir: str):
 # oracle-engine snapshots (the scalar replica is plain host state)
 
 def save_oracle(ckpt_dir: str, oracle, offset: int,
-                keep: Optional[int] = None) -> str:
+                keep: Optional[int] = None,
+                extra: Optional[dict] = None) -> str:
     """The engine is pickled to bytes FIRST so the blob can carry a
     sha256 of exactly those bytes — load verifies the digest before
     unpickling, so a bit-flip that still pickle-parses is caught."""
@@ -620,10 +641,13 @@ def save_oracle(ckpt_dir: str, oracle, offset: int,
     engine_pkl = pickle.dumps(oracle)
     path = os.path.join(ckpt_dir, f"ckpt-{offset}.pkl")
     tmp = path + ".tmp"
+    blob = {"version": 1, "kind": "oracle", "offset": int(offset),
+            "engine_pkl": engine_pkl,
+            "digest": hashlib.sha256(engine_pkl).hexdigest()}
+    if extra:
+        blob["extra"] = dict(extra)
     with open(tmp, "wb") as f:
-        pickle.dump({"version": 1, "kind": "oracle", "offset": int(offset),
-                     "engine_pkl": engine_pkl,
-                     "digest": hashlib.sha256(engine_pkl).hexdigest()}, f)
+        pickle.dump(blob, f)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
@@ -665,3 +689,67 @@ def load_oracle(ckpt_dir: str):
             print(f"kme_tpu.checkpoint: skipping unreadable snapshot "
                   f"{path}: {e}", file=sys.stderr)
     return None, 0
+
+
+# ---------------------------------------------------------------------------
+# cross-kind snapshot metadata (the exactly-once produce-stamp cursor)
+
+_ALL_SNAP_RES = (_CKPT_RE,
+                 re.compile(r"^ckpt-(\d+)\.nat$"),
+                 re.compile(r"^ckpt-(\d+)\.pkl$"))
+
+
+def snapshot_extra(ckpt_dir: str, offset: int) -> dict:
+    """The additive ``extra`` meta dict stored with the snapshot at
+    exactly `offset` (any snapshot kind); {} when absent or unreadable.
+    The caller already loaded the snapshot itself, so failures here
+    degrade to an empty cursor (epoch 0 / out_seq 0), which the broker's
+    recovered watermark still keeps duplicate-free."""
+    import pickle
+
+    npz = snapshot_path(ckpt_dir, offset)
+    if os.path.exists(npz):
+        try:
+            data = np.load(npz)
+            meta = json.loads(bytes(data["meta"]).decode())
+            return dict(meta.get("extra") or {})
+        except Exception:
+            return {}
+    nat = os.path.join(ckpt_dir, f"ckpt-{offset}.nat")
+    if os.path.exists(nat):
+        try:
+            with open(nat, "r", encoding="utf-8") as f:
+                header = json.loads(f.readline())
+            return dict(header.get("extra") or {})
+        except Exception:
+            return {}
+    pkl = os.path.join(ckpt_dir, f"ckpt-{offset}.pkl")
+    if os.path.exists(pkl):
+        try:
+            with open(pkl, "rb") as f:
+                blob = pickle.load(f)
+            return dict(blob.get("extra") or {})
+        except Exception:
+            return {}
+    return {}
+
+
+def oldest_retained_offset(ckpt_dir: str) -> Optional[int]:
+    """Smallest snapshot offset still on disk (any kind), or None when
+    there are no snapshots. The journal's retention guard
+    (telemetry/journal.py): a rotated journal segment may only be
+    pruned once every event in it is OLDER than this — a standby
+    restoring the oldest snapshot must still be able to replay to the
+    tip."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    oldest = None
+    for name in os.listdir(ckpt_dir):
+        for pat in _ALL_SNAP_RES:
+            m = pat.match(name)
+            if m:
+                off = int(m.group(1))
+                if oldest is None or off < oldest:
+                    oldest = off
+                break
+    return oldest

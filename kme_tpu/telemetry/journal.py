@@ -304,7 +304,8 @@ class Journal:
                  rotate_bytes: Optional[int] = None,
                  fsync: str = "off", shard: int = 0,
                  resume: bool = True, async_write: bool = False,
-                 clock=None) -> None:
+                 clock=None, rotate_keep: Optional[int] = None,
+                 retention_guard=None) -> None:
         if fmt is None:
             fmt = ("binary" if path.endswith((".bin", ".kmej"))
                    else "jsonl")
@@ -315,6 +316,16 @@ class Journal:
         self.path = path
         self.fmt = fmt
         self.rotate_bytes = rotate_bytes
+        # bound how many rotated segments are retained (None = keep
+        # all, the historical behavior). retention_guard, when set, is
+        # a zero-arg callable returning the oldest input offset a
+        # restore could still need (the oldest retained snapshot's
+        # offset, runtime/checkpoint.oldest_retained_offset) — a
+        # segment containing any event at or past that offset is NEVER
+        # pruned, whatever rotate_keep says: a standby restoring the
+        # oldest snapshot must still replay the journal to the tip.
+        self.rotate_keep = rotate_keep
+        self.retention_guard = retention_guard
         self.fsync = fsync
         self.shard = shard
         self.observers: List = []
@@ -482,9 +493,47 @@ class Journal:
         for k in range(n, 0, -1):
             src = self.path if k == 1 else f"{self.path}.{k - 1}"
             os.replace(src, f"{self.path}.{k}")
+        self._prune_rotated()
         self._f = open(self.path, "ab")
         if self.fmt == "binary":
             self._f.write(MAGIC)
+
+    def _prune_rotated(self) -> None:
+        """Unlink rotated segments beyond `rotate_keep`, oldest (largest
+        .N) first, but never one the retention guard still needs — and
+        stop at the first still-needed segment, since everything newer
+        is needed too. A guard that errors or reports no snapshot keeps
+        everything (fail safe: losing disk to journals beats losing the
+        ability to replay)."""
+        if not self.rotate_keep:
+            return
+        n = 1
+        while os.path.exists(f"{self.path}.{n}"):
+            n += 1
+        if n - 1 <= self.rotate_keep:
+            return
+        guard = None
+        if self.retention_guard is not None:
+            try:
+                guard = self.retention_guard()
+            except Exception:
+                return
+            if guard is None:
+                return      # no snapshot yet: every event may replay
+        for k in range(n - 1, self.rotate_keep, -1):
+            seg = f"{self.path}.{k}"
+            if guard is not None:
+                try:
+                    newest = max((int(ev.get("off", -1))
+                                  for ev in iter_events(seg)), default=-1)
+                except (OSError, ValueError, TypeError):
+                    return
+                if newest >= guard:
+                    return
+            try:
+                os.unlink(seg)
+            except OSError:
+                return
 
     # -- lifecycle ------------------------------------------------------
 

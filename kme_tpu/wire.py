@@ -322,6 +322,27 @@ class WireBatch:
 
 
 @dataclasses.dataclass(frozen=True)
+class ProduceStamp:
+    """The exactly-once produce stamp carried ALONGSIDE each MatchOut
+    record (never inside the value — the visible `<key> <value>` stream
+    stays byte-pinned against the reference, which shipped with Kafka's
+    exactly-once path commented out, KProcessor.java:29).
+
+    `epoch` is the producing leader's fencing token (bridge/lease.py —
+    monotonic across incarnations and failovers); `out_seq` is the
+    0-based position of the record in the deterministic output stream.
+    Because the engine is deterministic, a crashed leader's replayed
+    tail regenerates records with IDENTICAL stamps, which is exactly
+    what lets the broker suppress them (bridge/broker.py idempotent
+    produce) and consumers dedup defensively
+    (bridge/consume.py DedupRing): duplicate detection needs no record
+    hashing, only the cursor."""
+
+    epoch: int
+    out_seq: int
+
+
+@dataclasses.dataclass(frozen=True)
 class OutRecord:
     """One record on the output stream: key is "IN" (pre-processing echo,
     KProcessor.java:97) or "OUT" (result echo / fill event,

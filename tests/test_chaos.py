@@ -120,3 +120,99 @@ def test_chaos_end_to_end_byte_exact(tmp_path):
     assert report["recovery_seconds_max"] is not None
     # the flight recorder survived the crashes too
     assert os.path.exists(os.path.join(run_dir, "journal.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# failover scenario: schedule, exactly-once verifier, end to end
+
+
+def _recs(*rows):
+    """(value, epoch, out_seq) triples -> stamped MatchOut Records."""
+    from kme_tpu.bridge.broker import Record
+
+    return [Record(i, "OUT", v, e, s)
+            for i, (v, e, s) in enumerate(rows)]
+
+
+OUT_G = [["OUT a0", "OUT a1"], ["OUT b0"], [], ["OUT d0"]]
+
+
+def test_failover_schedule_is_one_seeded_midstream_kill():
+    from kme_tpu.bridge.chaos import failover_schedule
+
+    sched = failover_schedule(3, 600)
+    assert "seed=3" in sched
+    assert "serve.kill:at=300" in sched
+    # ONLY the kill: nothing else may muddy the failure fingerprint
+    assert sched.count(";") == 1
+    assert failover_schedule(0, 1) == "seed=0;serve.kill:at=1"
+
+
+def test_verify_failover_passes_clean_two_epoch_stream():
+    from kme_tpu.bridge.chaos import verify_failover
+
+    ok, d = verify_failover(_recs(("a0", 1, 0), ("a1", 1, 1),
+                                  ("b0", 2, 2), ("d0", 2, 3)), OUT_G)
+    assert ok, d
+    assert d["epochs"] == [1, 2]
+    assert d["duplicates_in_log"] == 0
+
+
+def test_verify_failover_rejects_duplicate_stamps_in_the_log():
+    from kme_tpu.bridge.chaos import verify_failover
+
+    ok, d = verify_failover(_recs(("a0", 1, 0), ("a1", 1, 1),
+                                  ("a1", 1, 1),      # escaped dedup
+                                  ("b0", 2, 2), ("d0", 2, 3)), OUT_G)
+    assert not ok
+    assert d["duplicates_in_log"] == 1
+    assert "duplicate produce stamp" in d["error"]
+
+
+def test_verify_failover_rejects_divergence():
+    from kme_tpu.bridge.chaos import verify_failover
+
+    ok, d = verify_failover(_recs(("a0", 1, 0), ("aX", 1, 1),
+                                  ("b0", 2, 2), ("d0", 2, 3)), OUT_G)
+    assert not ok
+    assert "diverges" in d["error"]
+
+
+def test_verify_failover_requires_a_promoted_epoch():
+    from kme_tpu.bridge.chaos import verify_failover
+
+    ok, d = verify_failover(_recs(("a0", 1, 0), ("a1", 1, 1),
+                                  ("b0", 1, 2), ("d0", 1, 3)), OUT_G)
+    assert not ok
+    assert "failover never happened" in d["error"]
+    assert d["epochs"] == [1]
+
+
+@pytest.mark.slow
+def test_chaos_failover_end_to_end_exactly_once(tmp_path):
+    """The failover acceptance run: a hot standby follows the leader,
+    the leader is SIGKILLed at a seeded offset, the supervisor promotes
+    the replica, and the durable MatchOut stream stays exactly-once
+    (byte-exact after dedup, dedup actually exercised, zombie produces
+    fenced) with the promotion under the failover bound."""
+    run_dir = str(tmp_path / "run")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "kme_tpu.cli", "chaos",
+         "--scenario", "failover", "--seed", "0", "--events", "600",
+         "--engine", "oracle", "--checkpoint-every", "60",
+         "--dir", run_dir, "--timeout", "120"],
+        env=env, capture_output=True, text=True, timeout=300)
+    sys.stderr.write(proc.stderr[-4000:])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(os.path.join(run_dir, "chaos-report.json")) as f:
+        report = json.load(f)
+    assert report["ok"] and not report["failures"]
+    fo = report["failover"]
+    assert fo["promotions"] >= 1
+    assert fo["failover_seconds"] and max(fo["failover_seconds"]) <= 2.0
+    assert fo["dup_suppressed_total"] > 0
+    assert fo["stale_epoch_fenced"] is True
+    assert fo["leader_epoch"] >= 2
+    assert report["verify"]["epochs"][-1] >= 2
+    assert report["verify"]["duplicates_in_log"] == 0

@@ -669,3 +669,42 @@ def test_retention_keep_depth(tmp_path, monkeypatch):
     for off in (10, 20):
         ck.save_session(d3, ses, offset=off)
     assert [o for o, _ in ck.list_snapshots(d3)] == [20]
+
+
+def test_snapshot_extra_meta_round_trips(tmp_path):
+    """The additive `extra` dict (the exactly-once epoch/out_seq
+    cursor) survives both the pkl and npz snapshot kinds, and degrades
+    to {} when absent."""
+    d = str(tmp_path)
+    ora = OracleEngine("fixed", book_slots=64, max_fills=32)
+    ck.save_oracle(d, ora, 40, extra={"epoch": 3, "out_seq": 99})
+    assert ck.snapshot_extra(d, 40) == {"epoch": 3, "out_seq": 99}
+    ck.save_oracle(d, ora, 80)                 # no extra stored
+    assert ck.snapshot_extra(d, 80) == {}
+    assert ck.snapshot_extra(d, 999) == {}     # no snapshot at all
+
+    ses = LaneSession(CFG)
+    ses.process_wire([m.copy() for m in _stream(50, seed=9)])
+    ck.save_session(d, ses, offset=50, extra={"epoch": 1, "out_seq": 7})
+    assert ck.snapshot_extra(d, 50) == {"epoch": 1, "out_seq": 7}
+    # ...and the snapshot still restores normally alongside the meta
+    resumed, offset = ck.load_session(d)
+    assert offset == 50
+    assert resumed.export_state() == ses.export_state()
+
+
+def test_oldest_retained_offset_tracks_pruning(tmp_path):
+    """The journal retention guard's anchor: the smallest snapshot
+    offset on disk, across snapshot kinds, moving forward as `keep`
+    prunes old snapshots."""
+    d = str(tmp_path / "ck")
+    assert ck.oldest_retained_offset(d) is None        # no dir yet
+    ora = OracleEngine("fixed", book_slots=64, max_fills=32)
+    ck.save_oracle(d, ora, 128)
+    ck.save_oracle(d, ora, 64)
+    assert ck.oldest_retained_offset(d) == 64
+    ses = LaneSession(CFG)
+    ck.save_session(d, ses, offset=32)                 # other kind
+    assert ck.oldest_retained_offset(d) == 32
+    ck.save_oracle(d, ora, 192, keep=2)                # prunes 64
+    assert ck.oldest_retained_offset(d) == 32          # npz untouched

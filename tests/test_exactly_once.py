@@ -1,0 +1,282 @@
+"""Exactly-once visible output: leader-epoch lease grants, broker-side
+fencing + idempotent produce (in-process and over the TCP wire),
+consumer-side dedup, the service's crash-replay stamp regeneration and
+the lease.steal self-fence."""
+
+import json
+import os
+
+import pytest
+
+from kme_tpu import faults
+from kme_tpu.bridge import lease
+from kme_tpu.bridge.broker import (BrokerFenced, InProcessBroker)
+from kme_tpu.bridge.consume import DedupRing
+from kme_tpu.bridge.provision import provision
+from kme_tpu.bridge.service import TOPIC_IN, TOPIC_OUT, MatchService
+from kme_tpu.wire import dumps_order
+from kme_tpu.workload import harness_stream
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# the lease
+
+
+def test_lease_epochs_are_monotonic(tmp_path):
+    d = str(tmp_path)
+    assert lease.current_epoch(d) == 0
+    assert lease.acquire(d) == 1
+    assert lease.acquire(d) == 2
+    assert lease.current_epoch(d) == 2
+    rec = lease.read(d)
+    assert rec["epoch"] == 2 and rec["role"] == "leader"
+    assert rec["pid"] == os.getpid()
+
+
+def test_lease_steal_advances_without_cooperation(tmp_path):
+    d = str(tmp_path)
+    assert lease.acquire(d) == 1
+    assert lease.steal(d) == 2
+    assert lease.read(d)["role"] == "stolen"
+    assert lease.acquire(d) == 3       # a later grant continues past it
+
+
+def test_lease_corruption_degrades_to_epoch_zero(tmp_path):
+    d = str(tmp_path)
+    lease.acquire(d)
+    with open(os.path.join(d, lease.LEASE_FILE), "w") as f:
+        f.write("{torn")
+    assert lease.read(d) == {}
+    assert lease.current_epoch(d) == 0
+    assert lease.acquire(d) == 1       # restart is slower, never dupes
+
+
+# ---------------------------------------------------------------------------
+# broker-side fencing + idempotent produce
+
+
+def test_stamped_produce_fences_stale_epochs():
+    b = InProcessBroker()
+    provision(b)
+    assert b.produce(TOPIC_OUT, "OUT", "a", epoch=2, out_seq=0) == 0
+    with pytest.raises(BrokerFenced) as ei:
+        b.produce(TOPIC_OUT, "OUT", "zombie", epoch=1, out_seq=99)
+    assert ei.value.code == "fenced"
+    assert b.fenced_produces == 1
+    assert b.fence_epoch == 2
+    # nothing was appended by the fenced produce
+    assert [r.value for r in b.fetch(TOPIC_OUT, 0)] == ["a"]
+
+
+def test_idempotent_produce_suppresses_replayed_stamps():
+    b = InProcessBroker()
+    provision(b)
+    for i in range(3):
+        b.produce(TOPIC_OUT, "OUT", f"v{i}", epoch=1, out_seq=i)
+    # the deterministic replay: same stamps, same payloads
+    for i in range(3):
+        assert b.produce(TOPIC_OUT, "OUT", f"v{i}", epoch=1,
+                         out_seq=i) == -1
+    assert b.dup_suppressed == 3
+    assert b.produce(TOPIC_OUT, "OUT", "v3", epoch=1, out_seq=3) == 3
+    assert [r.value for r in b.fetch(TOPIC_OUT, 0)] == \
+        ["v0", "v1", "v2", "v3"]
+
+
+def test_explicit_fence_rejects_the_previous_epoch():
+    """A promoted leader must fence BEFORE the zombie's next produce:
+    the reloaded log only teaches prior epochs, fence() closes the
+    same-epoch gap."""
+    b = InProcessBroker()
+    provision(b)
+    b.produce(TOPIC_OUT, "OUT", "a", epoch=1, out_seq=0)
+    b.fence(2)
+    with pytest.raises(BrokerFenced):
+        b.produce(TOPIC_OUT, "OUT", "late", epoch=1, out_seq=1)
+    assert b.produce(TOPIC_OUT, "OUT", "new", epoch=2, out_seq=1) == 1
+    b.fence(1)                         # fence never regresses
+    assert b.fence_epoch == 2
+
+
+def test_stamps_watermark_and_fence_recover_from_reload(tmp_path):
+    d = str(tmp_path)
+    b = InProcessBroker(persist_dir=d)
+    provision(b)
+    b.produce(TOPIC_OUT, "OUT", "plain")            # unstamped: 2-elem
+    for i in range(4):
+        b.produce(TOPIC_OUT, "OUT", f"s{i}", epoch=3, out_seq=i)
+    rows = [json.loads(ln)
+            for ln in open(os.path.join(d, f"{TOPIC_OUT}.log"))]
+    assert [len(r) for r in rows] == [2, 4, 4, 4, 4]
+    assert rows[1][2:] == [3, 0]
+
+    b2 = InProcessBroker(persist_dir=d)             # crash + reload
+    assert b2.fence_epoch == 3
+    # replayed stamps vanish; stale epochs die
+    assert b2.produce(TOPIC_OUT, "OUT", "s3", epoch=3, out_seq=3) == -1
+    assert b2.dup_suppressed == 1
+    with pytest.raises(BrokerFenced):
+        b2.produce(TOPIC_OUT, "OUT", "x", epoch=2, out_seq=10)
+    assert b2.produce(TOPIC_OUT, "OUT", "s4", epoch=3, out_seq=4) >= 0
+    recs = b2.fetch(TOPIC_OUT, 0, 100)
+    assert [r.value for r in recs] == ["plain", "s0", "s1", "s2",
+                                      "s3", "s4"]
+    assert recs[0].epoch is None and recs[1].epoch == 3
+
+
+def test_stamps_round_trip_over_tcp():
+    from kme_tpu.bridge.tcp import TcpBroker, serve_broker
+
+    srv, broker = serve_broker("127.0.0.1", 0)
+    try:
+        host, port = srv.server_address[:2]
+        c = TcpBroker(host, port, timeout=5.0)
+        provision(c)
+        assert c.produce(TOPIC_OUT, "OUT", "a", epoch=2, out_seq=0) == 0
+        assert c.produce(TOPIC_OUT, "OUT", "a", epoch=2, out_seq=0) == -1
+        with pytest.raises(BrokerFenced):
+            c.produce(TOPIC_OUT, "OUT", "z", epoch=1, out_seq=5)
+        c.fence(3)
+        with pytest.raises(BrokerFenced):
+            c.produce(TOPIC_OUT, "OUT", "z", epoch=2, out_seq=5)
+        recs = c.fetch(TOPIC_OUT, 0, 10)
+        assert [(r.value, r.epoch, r.out_seq) for r in recs] == \
+            [("a", 2, 0)]
+        # unstamped records keep the 3-element wire row
+        c.produce(TOPIC_IN, None, "plain")
+        rec = c.fetch(TOPIC_IN, 0, 1)[0]
+        assert rec.epoch is None and rec.out_seq is None
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# consumer-side dedup ring
+
+
+def test_dedup_ring_counts_and_passes_unstamped():
+    ring = DedupRing(capacity=128)
+    assert not ring.is_dup(1, 0)
+    assert ring.is_dup(1, 0)
+    assert not ring.is_dup(2, 0)       # same seq, new epoch: distinct
+    assert not ring.is_dup(None, None)
+    assert not ring.is_dup(None, None)  # unstamped never dedups
+    assert ring.suppressed == 1
+
+
+def test_dedup_ring_capacity_evicts_oldest():
+    ring = DedupRing(capacity=2)
+    assert not ring.is_dup(1, 0)
+    assert not ring.is_dup(1, 1)
+    assert not ring.is_dup(1, 2)       # evicts (1, 0)
+    assert not ring.is_dup(1, 0)       # forgotten: passes again
+    assert ring.is_dup(1, 2)           # still in the ring
+
+
+# ---------------------------------------------------------------------------
+# the service: crash, resume, replay — zero visible duplicates
+
+
+def _feed(broker, n=80, seed=3):
+    msgs = harness_stream(n, seed=seed, num_accounts=4, num_symbols=2,
+                          payout_opcode_bug=False, validate=True)
+    for m in msgs:
+        broker.produce(TOPIC_IN, None, dumps_order(m))
+    return len(msgs)
+
+
+def test_crash_replay_regenerates_identical_stamps(tmp_path):
+    """The whole point of the stamps: a leader killed AFTER producing
+    but BEFORE checkpointing re-produces its post-snapshot tail with
+    the same (epoch, out_seq) stamps, and the broker's watermark keeps
+    the durable log duplicate-free — byte-exact, exactly once."""
+    ck = str(tmp_path / "ck")
+    logd = str(tmp_path / "logs")
+    b = InProcessBroker(persist_dir=logd)
+    provision(b)
+    n = _feed(b)
+
+    svc = MatchService(b, engine="oracle", compat="fixed", batch=16,
+                       slots=64, max_fills=32, checkpoint_dir=ck,
+                       exactly_once=True)
+    assert svc.epoch == 1
+    assert svc.run(max_messages=48) == 48
+    svc.checkpoint()                  # snapshot carries out_seq cursor
+    seq_at_ckpt = svc.out_seq
+    assert svc.run(max_messages=16) == 16   # past the snapshot...
+    produced = b.end_offset(TOPIC_OUT)
+    del svc                           # ...then SIGKILL (no teardown)
+
+    b2 = InProcessBroker(persist_dir=logd)  # broker reload
+    svc2 = MatchService(b2, engine="oracle", compat="fixed", batch=16,
+                        slots=64, max_fills=32, checkpoint_dir=ck,
+                        exactly_once=True)
+    assert svc2.epoch == 2            # fresh epoch, predecessors fenced
+    assert svc2.offset == 48 and svc2.out_seq == seq_at_ckpt
+    assert svc2.run(max_messages=n - 48) == n - 48
+
+    recs = b2.fetch(TOPIC_OUT, 0, 10 ** 6)
+    # the 16-message overlap was re-produced and suppressed
+    assert b2.dup_suppressed > 0
+    ring = DedupRing()
+    assert not any(ring.is_dup(r.epoch, r.out_seq) for r in recs)
+    # byte-exact against a clean single-incarnation run
+    b3 = InProcessBroker()
+    provision(b3)
+    _feed(b3)
+    ref = MatchService(b3, engine="oracle", compat="fixed", batch=16,
+                       slots=64, max_fills=32)
+    ref.run(max_messages=n)
+    want = [r.value for r in b3.fetch(TOPIC_OUT, 0, 10 ** 6)]
+    assert [r.value for r in recs] == want
+    assert produced <= len(recs)      # nothing visible was lost
+    snap = svc2.telemetry.snapshot()["gauges"]
+    assert snap["leader_epoch"] == 2
+    assert snap["dup_suppressed_total"] == b2.dup_suppressed
+
+
+def test_follower_counts_but_discards_output(tmp_path):
+    """Follower mode: produces are discarded by the follow broker, but
+    the out_seq cursor still advances so a promotion continues the
+    stamp stream exactly where the durable log ends."""
+    ck = str(tmp_path / "ck")
+    b = InProcessBroker()
+    provision(b)
+    n = _feed(b, n=40)
+    svc = MatchService(b, engine="oracle", compat="fixed", batch=16,
+                       slots=64, max_fills=32, checkpoint_dir=ck,
+                       exactly_once=True, follower=True)
+    assert svc.epoch is None          # no lease held while following
+    assert svc.run(max_messages=n) == n
+    assert svc.out_seq > 0
+    assert lease.current_epoch(ck) == 0
+
+
+def test_lease_steal_self_fences_the_checkpoint(tmp_path):
+    """The lease.steal drill: a rival grabs the next epoch right before
+    our checkpoint — the deposed leader must refuse to write (its
+    snapshot would roll the new leader's state machine back) and die
+    fenced."""
+    ck = str(tmp_path / "ck")
+    b = InProcessBroker()
+    provision(b)
+    _feed(b, n=30)
+    svc = MatchService(b, engine="oracle", compat="fixed", batch=16,
+                       slots=64, max_fills=32, checkpoint_dir=ck,
+                       exactly_once=True)
+    svc.run(max_messages=30)
+    faults.configure("lease.steal")
+    with pytest.raises(BrokerFenced, match="superseded"):
+        svc.checkpoint()
+    assert lease.current_epoch(ck) == 2        # the rival's epoch
+    with pytest.raises(BrokerFenced):          # and we are broker-fenced
+        b.produce(TOPIC_OUT, "OUT", "late", epoch=svc.epoch,
+                  out_seq=svc.out_seq)

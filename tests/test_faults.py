@@ -207,3 +207,19 @@ def test_checkpoint_post_write_faults_then_fallback(tmp_path):
     ck.save_oracle(str(tmp_path), ora, 40)
     loaded, offset = ck.load_oracle(str(tmp_path))
     assert offset == 20 and loaded is not None  # fell back past the tear
+
+
+def test_exactly_once_fault_points_parse_and_fire():
+    """The robustness-drill points behind the exactly-once machinery:
+    lease.steal (split-brain: a rival takes the next epoch before our
+    checkpoint) and standby.lag (the follower stalls mid-tail)."""
+    plan = FaultPlan("seed=1;lease.steal:n=1;standby.lag:at=64")
+    assert plan.fire("lease.steal") is not None
+    assert plan.fire("lease.steal") is None        # n=1 spent
+    assert plan.fire("standby.lag", offset=32) is None
+    assert plan.fire("standby.lag", offset=64) is not None
+    assert plan.fire("standby.lag", offset=128) is None
+
+    faults.configure("lease.steal:n=1")            # module registry too
+    assert faults.should("lease.steal")
+    assert not faults.should("lease.steal")

@@ -262,3 +262,90 @@ def test_iter_events_plain_jsonl_without_stamps(tmp_path):
         f.write(json.dumps({"e": "submit", "oid": 1}) + "\n")
         f.write('{"e":"accept","oid":1}')   # torn final line: ignored
     assert list(iter_events(path)) == [{"e": "submit", "oid": 1}]
+
+
+# ---------------------------------------------------------------------------
+# retention: rotate_keep bounded by the snapshot retention guard
+
+
+def _segments(path):
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        n += 1
+    return n - 1
+
+
+def test_rotate_keep_prunes_old_segments(tmp_path):
+    _, groups = _wire_groups()
+    free = str(tmp_path / "free.jsonl")
+    _fill_journal(free, groups, chunk=20, rotate_bytes=2048)
+    assert _segments(free) >= 3                # enough history to prune
+
+    kept = str(tmp_path / "kept.jsonl")
+    _fill_journal(kept, groups, chunk=20, rotate_bytes=2048,
+                  rotate_keep=2)
+    assert _segments(kept) == 2
+    # the live file plus the kept segments still replay contiguously
+    # from SOME offset — the newest events are never the ones pruned
+    offs = [ev["off"] for ev in read_events(kept) if "off" in ev]
+    assert offs == sorted(offs)
+    assert max(offs) == max(ev["off"] for ev in read_events(free)
+                            if "off" in ev)
+
+
+def test_retention_guard_blocks_pruning_of_replayable_segments(tmp_path):
+    """The journal/snapshot retention coupling: a rotated segment may
+    only be dropped once every event in it is older than the OLDEST
+    retained snapshot — a standby restoring that snapshot must still
+    be able to replay to the tip."""
+    _, groups = _wire_groups()
+
+    # guard pinned at offset 0 (oldest snapshot never pruned): every
+    # segment is still replayable, rotate_keep must be overridden
+    p = str(tmp_path / "pinned.jsonl")
+    _fill_journal(p, groups, chunk=20, rotate_bytes=2048,
+                  rotate_keep=1, retention_guard=lambda: 0)
+    assert _segments(p) > 1
+
+    # guard beyond the tip: nothing is needed, rotate_keep rules
+    t = str(tmp_path / "tip.jsonl")
+    _fill_journal(t, groups, chunk=20, rotate_bytes=2048,
+                  rotate_keep=1, retention_guard=lambda: 10 ** 9)
+    assert _segments(t) == 1
+
+    # fail-safe: a guard that errors, or reports no snapshot at all,
+    # keeps everything
+    e = str(tmp_path / "err.jsonl")
+    _fill_journal(e, groups, chunk=20, rotate_bytes=2048,
+                  rotate_keep=1,
+                  retention_guard=lambda: (_ for _ in ()).throw(OSError()))
+    assert _segments(e) > 1
+    n = str(tmp_path / "none.jsonl")
+    _fill_journal(n, groups, chunk=20, rotate_bytes=2048,
+                  rotate_keep=1, retention_guard=lambda: None)
+    assert _segments(n) > 1
+
+
+def test_retention_guard_wires_to_snapshot_offsets(tmp_path):
+    """With the REAL guard (checkpoint.oldest_retained_offset): an old
+    snapshot on disk holds every segment; once only a late snapshot
+    remains, history behind it becomes prunable."""
+    from kme_tpu.oracle import OracleEngine
+    from kme_tpu.runtime import checkpoint as ck
+
+    _, groups = _wire_groups()
+    ckd = str(tmp_path / "ck")
+    guard = lambda: ck.oldest_retained_offset(ckd)
+
+    ora = OracleEngine("fixed")
+    ck.save_oracle(ckd, ora, 0)                # snapshot at the start
+    held = str(tmp_path / "held.jsonl")
+    _fill_journal(held, groups, chunk=20, rotate_bytes=2048,
+                  rotate_keep=1, retention_guard=guard)
+    assert _segments(held) > 1                 # replay from 0 intact
+
+    ck.save_oracle(ckd, ora, 10 ** 6, keep=1)  # prunes the 0 snapshot
+    late = str(tmp_path / "late.jsonl")
+    _fill_journal(late, groups, chunk=20, rotate_bytes=2048,
+                  rotate_keep=1, retention_guard=guard)
+    assert _segments(late) == 1

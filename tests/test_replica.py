@@ -1,0 +1,203 @@
+"""Hot-standby replica: the _FollowBroker tail, pid-addressed promote
+orders, the one-batch holdback bound, and the follow -> promote
+state machine end to end (exactly-once across the failover)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kme_tpu.bridge import lease
+from kme_tpu.bridge.broker import BrokerError, InProcessBroker
+from kme_tpu.bridge.consume import DedupRing
+from kme_tpu.bridge.provision import provision
+from kme_tpu.bridge.replica import _FollowBroker, Replica
+from kme_tpu.bridge.service import TOPIC_IN, TOPIC_OUT, MatchService
+from kme_tpu.wire import dumps_order
+from kme_tpu.workload import harness_stream
+
+
+# ---------------------------------------------------------------------------
+# _FollowBroker: a bounded tail over the leader's durable MatchIn log
+
+
+def _log(tmp_path, lines):
+    path = tmp_path / f"{TOPIC_IN}.log"
+    with open(path, "ab") as f:
+        for ln in lines:
+            f.write(ln)
+    return path
+
+
+def test_follow_broker_tails_and_respects_limit(tmp_path):
+    fb = _FollowBroker(str(tmp_path))
+    assert fb.fetch(TOPIC_IN, 0, 10) == []      # log not created yet
+    _log(tmp_path, [b'["k", "a"]\n', b'["k", "b"]\n', b'["k", "c"]\n'])
+    assert fb.fetch(TOPIC_IN, 0, 10) == []      # limit still 0
+    fb.limit = 2
+    assert [r.value for r in fb.fetch(TOPIC_IN, 0, 10)] == ["a", "b"]
+    assert fb.end_offset(TOPIC_IN) == 3         # end_offset is unbounded
+    fb.limit = 10
+    _log(tmp_path, [b'["k", "d", 2, 7]\n'])     # stamped row tails too
+    recs = fb.fetch(TOPIC_IN, 0, 10)
+    assert [r.value for r in recs] == ["a", "b", "c", "d"]
+    assert (recs[3].epoch, recs[3].out_seq) == (2, 7)
+    assert recs[0].epoch is None
+
+
+def test_follow_broker_leaves_torn_tail_unconsumed(tmp_path):
+    fb = _FollowBroker(str(tmp_path))
+    fb.limit = 10
+    _log(tmp_path, [b'["k", "a"]\n', b'["k", "b'])      # torn mid-append
+    assert [r.value for r in fb.fetch(TOPIC_IN, 0, 10)] == ["a"]
+    _log(tmp_path, [b'"]\n'])                           # append completes
+    assert [r.value for r in fb.fetch(TOPIC_IN, 0, 10)] == ["a", "b"]
+
+
+def test_follow_broker_resets_when_file_shrinks(tmp_path):
+    fb = _FollowBroker(str(tmp_path))
+    fb.limit = 10
+    path = _log(tmp_path, [b'["k", "a"]\n', b'["k", "b"]\n'])
+    assert len(fb.fetch(TOPIC_IN, 0, 10)) == 2
+    with open(path, "wb") as f:                 # fresh run reused the dir
+        f.write(b'["k", "z"]\n')
+    fb.fetch(TOPIC_IN, 0, 10)                   # notices the truncation
+    assert [r.value for r in fb.fetch(TOPIC_IN, 0, 10)] == ["z"]
+
+
+def test_follow_broker_rejects_unknown_topic_and_counts_discards(tmp_path):
+    fb = _FollowBroker(str(tmp_path))
+    with pytest.raises(BrokerError):
+        fb.fetch(TOPIC_OUT, 0, 10)
+    assert fb.produce(TOPIC_OUT, "OUT", "x") == -1
+    assert fb.produce(TOPIC_OUT, "OUT", "y") == -1
+    assert fb.discarded == 2
+
+
+# ---------------------------------------------------------------------------
+# the promote order is pid-addressed
+
+
+def _mk_replica(tmp_path, **kw):
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck, exist_ok=True)
+    kw.setdefault("engine", "oracle")
+    kw.setdefault("batch", 16)
+    kw.setdefault("slots", 64)
+    kw.setdefault("max_fills", 32)
+    kw.setdefault("poll", 0.02)
+    kw.setdefault("health_every", 0.05)
+    return Replica(ck, listen="127.0.0.1:0", **kw)
+
+
+def test_read_promote_ignores_orders_for_other_pids(tmp_path):
+    rep = _mk_replica(tmp_path)
+    assert rep._read_promote() is None          # no file
+    with open(rep.promote_file, "w") as f:
+        json.dump({"failed_at": 1.0, "pid": os.getpid() + 1}, f)
+    assert rep._read_promote() is None          # someone else's order
+    assert os.path.exists(rep.promote_file)     # ...and left intact
+    with open(rep.promote_file, "w") as f:
+        json.dump({"failed_at": 1.0, "pid": os.getpid()}, f)
+    assert rep._read_promote()["failed_at"] == 1.0
+    with open(rep.promote_file, "w") as f:
+        json.dump({"failed_at": 2.0}, f)        # pid-less: manual/test
+    assert rep._read_promote()["failed_at"] == 2.0
+
+
+def test_leader_offset_requires_leader_role(tmp_path):
+    rep = _mk_replica(tmp_path)
+    assert rep._leader_offset() == 0
+    with open(rep.serve_health, "w") as f:
+        json.dump({"role": "standby", "offset": 99}, f)
+    assert rep._leader_offset() == 0            # never follow a follower
+    with open(rep.serve_health, "w") as f:
+        json.dump({"role": "leader", "offset": 80}, f)
+    assert rep._leader_offset() == 80
+
+
+# ---------------------------------------------------------------------------
+# follow -> promote, end to end (threads, no subprocesses)
+
+
+@pytest.mark.slow
+def test_failover_is_exactly_once_end_to_end(tmp_path):
+    """A leader checkpoints at 48, keeps producing durably through 80,
+    then dies. The standby (snapshot 48, holdback-bounded tail) is
+    promoted: it must re-produce the checkpoint..durable overlap, have
+    every duplicate stamp suppressed, and finish the stream byte-exact
+    with a clean single-leader run."""
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    log_dir = os.path.join(ck, "broker-log")
+    batch = 16
+    msgs = [dumps_order(m) for m in harness_stream(
+        112, seed=5, num_accounts=4, num_symbols=2,
+        payout_opcode_bug=False, validate=True)]
+    n = len(msgs)                               # preamble included
+
+    # -- the doomed leader: checkpoint at 48, durable output through 80
+    b = InProcessBroker(persist_dir=log_dir)
+    provision(b)
+    for m in msgs:
+        b.produce(TOPIC_IN, None, m)
+    leader = MatchService(b, engine="oracle", compat="fixed", batch=batch,
+                          slots=64, max_fills=32, checkpoint_dir=ck,
+                          exactly_once=True)
+    assert leader.epoch == 1
+    leader.run(max_messages=48)
+    leader.checkpoint()
+    leader.run(max_messages=32)                 # durable but un-snapshotted
+    with open(os.path.join(ck, "serve.health"), "w") as f:
+        json.dump({"pid": 1, "time": time.time(), "role": "leader",
+                   "offset": leader.offset, "tick": 9}, f)
+    assert leader.offset == 80
+    del leader                                  # SIGKILL: no teardown
+
+    # -- the standby follows, bounded one batch behind
+    rep = Replica(ck, listen="127.0.0.1:0", engine="oracle", batch=batch,
+                  slots=64, max_fills=32, poll=0.02, health_every=0.05,
+                  idle_exit=0.5,
+                  health_file=os.path.join(ck, "standby.health"))
+    assert rep.svc.offset == 48                 # restored the snapshot
+    rc = [None]
+    t = threading.Thread(target=lambda: rc.__setitem__(0, rep.run()),
+                         daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while rep.svc.offset < 80 - batch and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert rep.svc.offset == 80 - batch         # the holdback bound
+    time.sleep(0.1)
+    assert rep.svc.offset == 80 - batch         # ...and it HOLDS
+    assert rep.follow.discarded > 0             # output counted, not kept
+
+    # -- promotion (pid-less order: test-driven)
+    failed_at = time.time()
+    with open(rep.promote_file, "w") as f:
+        json.dump({"failed_at": failed_at}, f)
+    t.join(timeout=30.0)
+    assert not t.is_alive() and rc[0] == 0
+    assert not os.path.exists(rep.promote_file)
+    assert lease.current_epoch(ck) == 2
+    gauges = rep.svc.telemetry.snapshot()["gauges"]
+    assert gauges["leader_epoch"] == 2
+    assert gauges["failover_seconds"] >= 0.0
+    assert gauges["dup_suppressed_total"] > 0   # the overlap replayed
+
+    # -- the durable MatchOut stream: deduped == byte-exact reference
+    rows = [json.loads(ln) for ln in
+            open(os.path.join(log_dir, f"{TOPIC_OUT}.log"))]
+    ring = DedupRing()
+    assert not any(ring.is_dup(r[2], r[3]) for r in rows)
+    b3 = InProcessBroker()
+    provision(b3)
+    for m in msgs:
+        b3.produce(TOPIC_IN, None, m)
+    ref = MatchService(b3, engine="oracle", compat="fixed", batch=batch,
+                       slots=64, max_fills=32)
+    ref.run(max_messages=n)
+    want = [r.value for r in b3.fetch(TOPIC_OUT, 0, 10 ** 6)]
+    assert [r[1] for r in rows] == want

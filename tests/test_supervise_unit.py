@@ -15,7 +15,11 @@ from kme_tpu.bridge.supervise import STATE_FILE, Supervisor
 
 class FakeChild:
     """A scripted child: exits `rc` once the fake clock passes
-    spawn + exit_after (None = runs forever until killed)."""
+    spawn + exit_after (None = runs forever until killed). Standby
+    fakes additionally "write" a heartbeat file on every poll
+    (hb_path), the way the real replica's follow loop does."""
+
+    _next_pid = iter(range(40_000, 50_000))
 
     def __init__(self, clock, exit_after=None, rc=1):
         self._clock = clock
@@ -24,17 +28,28 @@ class FakeChild:
         self.returncode = None
         self.spawned_at = None
         self.env = None
+        self.pid = next(FakeChild._next_pid)
+        self.hb_path = None
 
     def poll(self):
         if (self.returncode is None and self.exit_after is not None
                 and self._clock() - self.spawned_at >= self.exit_after):
             self.returncode = self.rc
+        if self.returncode is None and self.hb_path is not None:
+            open(self.hb_path, "a").close()
         return self.returncode
 
     def send_signal(self, sig):
         self.returncode = -9
 
-    def wait(self):
+    def terminate(self):
+        if self.returncode is None:
+            self.returncode = -15
+
+    def kill(self):
+        self.returncode = -9
+
+    def wait(self, timeout=None):
         return self.returncode
 
 
@@ -211,6 +226,101 @@ def test_recovery_time_measured_and_state_mirrored(tmp_path):
     assert state["restarts_total"] == 1
     assert state["fingerprints"] == {"exit:1": 1}
     assert state["recoveries"][0]["recovered_in"] == rec["recovered_in"]
+
+
+def test_closing_heartbeat_suppresses_the_stall_detector(tmp_path):
+    """Same frozen-tick script as the stall test above, but the child's
+    final heartbeat carries closing=true (deliberate idle-exit): the
+    stall detector must stand down and let the clean exit land."""
+    h = Harness(tmp_path, stall_after=3.0, stale_after=10_000)
+    h._pending[0].exit_after, h._pending[0].rc = 10.0, 0
+    h.age = lambda: 0.1
+    h.tick = lambda: min(int(h.now), 2)        # advances, then freezes
+    h.sup._hb_closing = lambda: True
+    assert h.sup.run() == 0
+    assert h.sup.fingerprints == {}
+    assert h.sup.restarts_total == 0
+
+
+# ---------------------------------------------------------------------------
+# hot-standby failover
+
+
+class StandbyHarness(Harness):
+    """Harness plus a second scripted-child lane for kme-standby
+    spawns (the supervisor's popen is dispatched on the subcommand)."""
+
+    def __init__(self, tmp_path, n_standby=4, standby_beats=True, **kw):
+        self.standby_spawned = []
+        self._standby_pending = []
+        super().__init__(tmp_path, standby=True, **kw)
+        for _ in range(n_standby):
+            c = FakeChild(self.clock)
+            if standby_beats:
+                c.hb_path = self.sup.standby_hb
+            self._standby_pending.append(c)
+
+    def _popen(self, cmd, env):
+        if "standby" in cmd:
+            child = self._standby_pending[len(self.standby_spawned)]
+            child.spawned_at = self.now
+            child.env = env
+            self.standby_spawned.append(child)
+            return child
+        return super()._popen(cmd, env)
+
+
+def test_failure_promotes_a_ready_standby_without_backoff(tmp_path):
+    h = StandbyHarness(tmp_path)
+    h._pending[0].exit_after, h._pending[0].rc = 2.0, 1
+    adoptee = h._standby_pending[0]
+    adoptee.exit_after, adoptee.rc = 8.0, 0    # serves, then exits clean
+    assert h.sup.run() == 0
+    # the standby was adopted, not a cold serve restart
+    assert len(h.spawned) == 1
+    assert len(h.standby_spawned) == 2         # adoptee + replacement
+    assert h.backoffs == []                    # adoption is not paced
+    assert h.sup.restarts_total == 1
+    rec = h.sup.recoveries[0]
+    assert rec["promoted"] is True
+    assert rec["failover_seconds"] == rec["recovered_in"]
+    # the promote order is addressed to the adoptee and SPARED by the
+    # replacement-standby launch (the adoptee may not have read it yet)
+    with open(h.sup.promote_file) as f:
+        order = json.load(f)
+    assert order["pid"] == adoptee.pid
+    assert order["fingerprint"] == "exit:1"
+    # clean exit stops the replacement replica
+    assert h.standby_spawned[1].returncode == -15
+
+
+def test_unready_standby_falls_back_to_cold_restart(tmp_path):
+    h = StandbyHarness(tmp_path, standby_beats=False)  # never heartbeats
+    h._pending[0].exit_after, h._pending[0].rc = 1.0, 1
+    h._pending[1].exit_after, h._pending[1].rc = 1.0, 0
+    assert h.sup.run() == 0
+    assert len(h.spawned) == 2                 # ordinary restart path
+    assert len(h.backoffs) == 1
+    assert not os.path.exists(h.sup.promote_file)
+    assert "promoted" not in h.sup.recoveries[0]
+
+
+def test_stale_promote_file_is_cleared_at_standby_launch(tmp_path):
+    h = StandbyHarness(tmp_path)
+    with open(h.sup.promote_file, "w") as f:   # yesterday's order
+        json.dump({"failed_at": 1.0, "pid": 12345}, f)
+    h._pending[0].exit_after, h._pending[0].rc = 1.0, 0
+    assert h.sup.run() == 0
+    assert not os.path.exists(h.sup.promote_file)
+
+
+def test_dead_standby_is_relaunched(tmp_path):
+    h = StandbyHarness(tmp_path)
+    h._standby_pending[0].exit_after = 1.0     # replica dies early
+    h._pending[0].exit_after, h._pending[0].rc = 4.0, 0
+    assert h.sup.run() == 0
+    assert len(h.standby_spawned) == 2
+    assert h.sup.standby_restarts == 1
 
 
 def test_reserved_serve_args_rejected(tmp_path):
