@@ -262,11 +262,11 @@ def _bench_seq_latency(symbols: int, accounts: int, seed: int,
         while pend:
             collect_one()
         return (time.perf_counter() - t_all, plan_s, recon_s, walls,
-                windows)
+                windows, ses)
 
     run(True)   # warm every shape (compile shared via lru caches)
-    t_serial, _, _, _, _ = run(False)
-    t_pipe, plan_s, recon_s, walls, windows = run(True)
+    t_serial, _, _, _, _, _ = run(False)
+    t_pipe, plan_s, recon_s, walls, windows, ses_pipe = run(True)
 
     from kme_tpu.telemetry.journal import measured_overlap_s
 
@@ -317,7 +317,24 @@ def _bench_seq_latency(symbols: int, accounts: int, seed: int,
             "run was genuinely hidden)")
         print(f"kme-bench: WARNING {res['pipeline_warning']}",
               file=sys.stderr)
+    publish_pipeline_gauges(ses_pipe.telemetry, res)
     return res
+
+
+def publish_pipeline_gauges(registry, detail: dict) -> None:
+    """Pipeline health as LIVE gauges (the same registry a
+    --metrics-port scrape or heartbeat snapshot reads). The warning
+    travels as a numeric 0/1 gauge — Prometheus carries no strings —
+    with the prose staying in the detail dict."""
+    g = registry.gauge
+    for k in ("pipeline_speedup", "device_ms_per_batch",
+              "measured_overlap_frac"):
+        if k in detail:
+            g(k).set(detail[k])
+    g("pipeline_warning",
+      "1 when pipeline_speedup fell under 1.0 (wall-clock ratio "
+      "noise-dominated; see measured_overlap_s)").set(
+        1 if detail.get("pipeline_warning") else 0)
 
 
 def bench_seq_engine(events: int = 100_000, symbols: int = 1024,
@@ -919,7 +936,39 @@ def main(argv=None) -> int:
                    help="seq suite: run the invariant auditor over the "
                         "best run's stream and report audit_s / "
                         "audit_overhead_frac / audit_violations")
+    p.add_argument("--baseline", default=None, metavar="BENCH.json",
+                   help="recorded benchmark artifact to compare "
+                        "against (a BENCH_r0N.json driver artifact, a "
+                        "detail JSON, or raw bench output)")
+    p.add_argument("--gate", action="store_true",
+                   help="with --baseline: exit 1 when a gated metric "
+                        "regressed beyond --tolerance (backend "
+                        "mismatch demotes to advisory, exit 0)")
+    p.add_argument("--tolerance", type=float, default=0.25,
+                   metavar="FRAC",
+                   help="allowed fractional degradation before the "
+                        "gate fails (0.25 = 25%%)")
+    p.add_argument("--gate-report", default=None, metavar="PATH",
+                   help="write the gate comparison report JSON here "
+                        "(CI uploads it as an artifact)")
+    p.add_argument("--gate-current", default=None, metavar="PATH",
+                   help="gate a PRE-RECORDED artifact against "
+                        "--baseline instead of running a bench (e.g. "
+                        "re-judge a CI artifact offline)")
     args = p.parse_args(argv)
+    if (args.gate or args.gate_current) and args.baseline is None:
+        p.error("--gate/--gate-current require --baseline")
+    if args.gate_current is not None:
+        from kme_tpu import perfgate
+
+        current = perfgate.load_artifact(args.gate_current)
+        if not current["metrics"]:
+            print(f"kme-bench --gate: no metrics found in "
+                  f"{args.gate_current!r}", file=sys.stderr)
+            return 2
+        return perfgate.run_gate(args.baseline, current,
+                                 tolerance=args.tolerance,
+                                 report_path=args.gate_report)
     tracer = None
     if args.trace_out is not None:
         from kme_tpu.telemetry import TraceRecorder, install
@@ -965,4 +1014,22 @@ def main(argv=None) -> int:
     out = {k: rec[k] for k in ("metric", "value", "unit", "vs_baseline")}
     print(json.dumps(out))
     print(json.dumps(rec["detail"]), file=sys.stderr)
+    if args.gate:
+        from kme_tpu import perfgate
+
+        # the headline scalar participates too (it carries the suite's
+        # one-number summary, e.g. orders_per_sec)
+        doc = dict(rec["detail"])
+        if rec.get("unit") == "orders/sec":
+            doc.setdefault("orders_per_sec", rec["value"])
+        return perfgate.run_gate(args.baseline,
+                                 perfgate.detail_to_artifact(doc),
+                                 tolerance=args.tolerance,
+                                 report_path=args.gate_report)
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

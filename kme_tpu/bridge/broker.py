@@ -78,6 +78,13 @@ class Record:
     value: str
     epoch: Optional[int] = None
     out_seq: Optional[int] = None
+    # broker-admission wall clock, microseconds since epoch — the
+    # INTENDED-START stamp for coordinated-omission-safe latency
+    # (stamped at produce time, before any queueing the consumer's
+    # dequeue rate would hide). In-memory only: log rows keep their
+    # [key,value(,epoch,out_seq)] shape, so records reloaded after a
+    # restart carry ats=None and latency attribution simply skips them.
+    ats: Optional[int] = None
 
 
 class _Topic:
@@ -115,6 +122,12 @@ class InProcessBroker:
         self._fence_epoch = 0
         self.fenced_produces = 0
         self.dup_suppressed = 0
+        # latency attribution hook: fn(topic, records, now_us) called
+        # after each non-empty fetch DELIVERS records to a consumer —
+        # the serving process hosts the broker, so consumer receipt of
+        # MatchOut is observable here (MatchService wires this to the
+        # lat_consume histogram). Called outside the broker lock.
+        self.deliver_observer = None
         if persist_dir is not None:
             os.makedirs(persist_dir, exist_ok=True)
             for name in sorted(os.listdir(persist_dir)):
@@ -235,7 +248,10 @@ class InProcessBroker:
                     f"{len(t.log) - self._commits[topic]} >= max_lag "
                     f"{self._max_lag}")
             off = len(t.log)
-            t.log.append(Record(off, key, value, epoch, out_seq))
+            import time as _time
+
+            t.log.append(Record(off, key, value, epoch, out_seq,
+                                _time.time_ns() // 1000))
             if out_seq is not None:
                 t.max_out_seq = out_seq
             if t.logfile is not None:
@@ -274,7 +290,16 @@ class InProcessBroker:
             if timeout > 0 and len(t.log) <= offset:
                 self._data.wait_for(lambda: len(t.log) > offset,
                                     timeout=timeout)
-            return t.log[offset:offset + max_records]
+            recs = t.log[offset:offset + max_records]
+        obs = self.deliver_observer
+        if obs is not None and recs:
+            import time as _time
+
+            try:
+                obs(topic, recs, _time.time_ns() // 1000)
+            except Exception:
+                pass        # observability must never fail a fetch
+        return recs
 
     def commit(self, topic: str, offset: int) -> None:
         """Advance a consumer watermark (arms the `max_lag` ingress

@@ -49,14 +49,21 @@ class DedupRing:
 
 def consume_lines(broker, offset: int = 0, follow: bool = True,
                   poll_timeout: float = 0.5, idle_exit: float = None,
-                  dedup: DedupRing = None):
+                  dedup: DedupRing = None, latency=None):
     """Yield `<key> <value>` lines from MatchOut starting at `offset`.
     follow=False stops at the current end; idle_exit stops after that
     many idle seconds. While following, a missing topic is polled for
     (subscribe-and-wait, like the reference consumer and
     MatchService.step) instead of crashing a consumer that was started
     before provisioning. `dedup` suppresses records whose produce stamp
-    the ring has already seen."""
+    the ring has already seen.
+
+    `latency` (a telemetry LatencyHistogram, or any object with
+    observe(seconds)) receives the receipt latency — now minus the
+    record's broker-admission stamp `ats` — for every delivered record
+    that carries one. This measures from intended start (produce
+    admission), not from this consumer's dequeue, so a stalled consumer
+    shows its backlog as latency instead of hiding it."""
     import time
 
     from kme_tpu.bridge.broker import BrokerError
@@ -85,10 +92,14 @@ def consume_lines(broker, offset: int = 0, follow: bool = True,
                 return
             continue
         idle_since = time.monotonic()
+        now_us = time.time_ns() // 1000
         for r in recs:
             if dedup is not None and dedup.is_dup(
                     getattr(r, "epoch", None), getattr(r, "out_seq", None)):
                 continue
+            ats = getattr(r, "ats", None)
+            if latency is not None and ats is not None:
+                latency.observe(max(0, now_us - ats) * 1e-6)
             yield f"{r.key} {r.value}"
         offset = recs[-1].offset + 1
 
@@ -103,15 +114,21 @@ def main(argv=None) -> int:
     p.add_argument("--no-dedup", action="store_true",
                    help="print replayed stamped records too (raw "
                         "at-least-once view of the log)")
+    p.add_argument("--latency", action="store_true",
+                   help="print a receipt-latency summary (produce "
+                        "admission -> consumer delivery) on exit")
     args = p.parse_args(argv)
     from kme_tpu.bridge.tcp import TcpBroker, parse_addr
+    from kme_tpu.telemetry import LatencyHistogram
 
     host, port = parse_addr(args.broker)
     client = TcpBroker(host, port)
     ring = None if args.no_dedup else DedupRing()
+    lat = LatencyHistogram("consume_receipt") if args.latency else None
     try:
         for line in consume_lines(client, follow=not args.no_follow,
-                                  idle_exit=args.idle_exit, dedup=ring):
+                                  idle_exit=args.idle_exit, dedup=ring,
+                                  latency=lat):
             print(line, flush=True)
     except KeyboardInterrupt:
         pass
@@ -120,4 +137,11 @@ def main(argv=None) -> int:
         if ring is not None and ring.suppressed:
             print(f"kme-consume: suppressed {ring.suppressed} duplicate "
                   f"record(s)", file=sys.stderr)
+        if lat is not None and lat.count:
+            qs = lat.quantiles()
+            print("kme-consume: receipt latency "
+                  f"n={lat.count} "
+                  f"p50={qs[0.5] * 1e3:.3f}ms "
+                  f"p99={qs[0.99] * 1e3:.3f}ms "
+                  f"p999={qs[0.999] * 1e3:.3f}ms", file=sys.stderr)
     return 0

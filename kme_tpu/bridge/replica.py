@@ -145,7 +145,8 @@ class Replica:
                  serve_health: Optional[str] = None,
                  poll: float = 0.2, health_every: float = 1.0,
                  max_messages: Optional[int] = None,
-                 idle_exit: Optional[float] = None) -> None:
+                 idle_exit: Optional[float] = None,
+                 metrics_port: Optional[int] = None) -> None:
         self.checkpoint_dir = checkpoint_dir
         self.listen = listen
         self.max_lag = max_lag
@@ -170,6 +171,18 @@ class Replica:
             checkpoint_every=checkpoint_every,
             checkpoint_keep=checkpoint_keep,
             exactly_once=True, follower=True)
+        self.metrics_server = None
+        if metrics_port is not None:
+            # the standby's own metrics surface (kme-top scrapes it
+            # next to the leader's to show replica lag live)
+            from kme_tpu.telemetry import start_metrics_server
+
+            self.metrics_server = start_metrics_server(
+                self.svc.telemetry, metrics_port)
+            print(f"kme-standby: metrics on http://"
+                  f"{self.metrics_server.server_address[0]}:"
+                  f"{self.metrics_server.server_address[1]}/metrics",
+                  file=sys.stderr)
 
     # -- following ------------------------------------------------------
 
@@ -210,7 +223,9 @@ class Replica:
                            "role": "standby", "applied": applied,
                            "tick": tick,
                            "out_seq": self.svc.out_seq,
-                           "discarded": self.follow.discarded}, f)
+                           "discarded": self.follow.discarded,
+                           "leader_offset": self._leader_offset(),
+                           "metrics": self.svc.telemetry.snapshot()}, f)
             os.replace(tmp, self.health_file)
         except OSError:
             pass        # reporting surface only
@@ -243,6 +258,14 @@ class Replica:
             now = time.monotonic()
             if now - last_hb >= self.health_every:
                 last_hb = now
+                lead = self._leader_offset()
+                t = svc.telemetry
+                t.gauge("replica_applied_offset").set(svc.offset)
+                t.gauge("replica_leader_offset").set(lead)
+                t.gauge("replica_lag_records",
+                        "input records the leader confirmed but this "
+                        "standby has not applied").set(
+                    max(0, lead - svc.offset))
                 self._write_heartbeat(svc.offset, tick)
 
     # -- promotion ------------------------------------------------------
@@ -350,6 +373,11 @@ def main(argv=None) -> int:
     p.add_argument("--poll", type=float, default=0.2,
                    help="follow-loop poll interval (also the promote-"
                         "file detection latency bound)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve this standby's own /metrics + "
+                        "/metrics.json (0 picks a free port); kme-top "
+                        "scrapes it next to the leader's")
     args, unknown = p.parse_known_args(argv)
     if unknown:
         # the supervisor forwards the leader's serve_args verbatim;
@@ -371,7 +399,8 @@ def main(argv=None) -> int:
                   serve_health=args.serve_health_file,
                   poll=args.poll, health_every=args.health_every,
                   max_messages=args.max_messages,
-                  idle_exit=args.idle_exit)
+                  idle_exit=args.idle_exit,
+                  metrics_port=args.metrics_port)
     try:
         return rep.run()
     except BrokerFenced as e:
@@ -379,6 +408,9 @@ def main(argv=None) -> int:
         return 75
     except KeyboardInterrupt:
         return 0
+    finally:
+        if rep.metrics_server is not None:
+            rep.metrics_server.shutdown()
 
 
 if __name__ == "__main__":

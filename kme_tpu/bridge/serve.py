@@ -124,6 +124,26 @@ def main(argv=None) -> int:
     p.add_argument("--audit-repro-dir", default=None, metavar="DIR",
                    help="write audit violation repro dumps here "
                         "(replayable with kme-trace --replay-repro)")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   metavar="MS",
+                   help="latency SLO: keep the p99 of --slo-stage under "
+                        "MS ms; sustained error-budget burn > 1 marks "
+                        "the heartbeat degraded (the supervisor channel "
+                        "audit violations already use) and flips the "
+                        "slo_ok gauge")
+    p.add_argument("--slo-stage", default="e2e",
+                   choices=("ingress", "plan", "device", "produce",
+                            "e2e", "consume"),
+                   help="which latency stage the SLO judges")
+    p.add_argument("--slo-budget", type=float, default=0.001,
+                   metavar="FRAC",
+                   help="allowed bad-event fraction (0.001 = 99.9%% of "
+                        "orders must meet the target)")
+    p.add_argument("--slo-min-ops", type=int, default=100, metavar="N",
+                   help="observations per window before the SLO judges "
+                        "(a quiet service is not a degraded one)")
+    p.add_argument("--slo-min-records-per-sec", type=float, default=0.0,
+                   metavar="R", help="optional throughput floor")
     p.add_argument("--annotate-rejects", action="store_true",
                    help="emit an ADDITIVE 'REJ'-keyed MatchOut record "
                         "naming each rejected order's rej_* reason "
@@ -192,7 +212,14 @@ def main(argv=None) -> int:
                        audit=args.audit,
                        audit_repro_dir=args.audit_repro_dir,
                        annotate_rejects=args.annotate_rejects,
-                       exactly_once=exactly_once)
+                       exactly_once=exactly_once,
+                       slo=(None if args.slo_p99_ms is None else {
+                           "stage": args.slo_stage,
+                           "p99_ms": args.slo_p99_ms,
+                           "budget": args.slo_budget,
+                           "min_ops": args.slo_min_ops,
+                           "min_records_per_s":
+                               args.slo_min_records_per_sec}))
     msrv = None
     if args.metrics_port is not None:
         from kme_tpu.telemetry import start_metrics_server

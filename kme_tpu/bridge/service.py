@@ -64,7 +64,8 @@ class MatchService:
                  audit_repro_dir: Optional[str] = None,
                  annotate_rejects: bool = False,
                  exactly_once: bool = False,
-                 follower: bool = False) -> None:
+                 follower: bool = False,
+                 slo=None) -> None:
         if engine not in ("lanes", "seq", "oracle", "native"):
             raise ValueError(f"unknown engine {engine!r}")
         if compat not in ("java", "fixed"):
@@ -113,6 +114,9 @@ class MatchService:
                              "annotate_rejects (REJ records interleave "
                              "at non-deterministic batch boundaries)")
         self.degraded = None        # set by the invariant auditor
+        self._slo_arg = slo         # dict of SLO kwargs, or None
+        self.slo = None
+        self._slo_reason = None
         resumed = False
         if checkpoint_dir is not None:
             resumed = self._try_resume(engine, compat, shards, width)
@@ -322,6 +326,64 @@ class MatchService:
                     round(max(0.0, time.time() - float(failed_at)), 3))
             except ValueError:
                 pass
+        self._init_latency()
+
+    def _init_latency(self) -> None:
+        """End-to-end latency attribution: one always-on streaming
+        quantile histogram per pipeline stage (telemetry/registry.py
+        LatencyHistogram — O(1) memory, lock-consistent snapshots).
+
+        Stage boundaries, all measured from the broker-admission stamp
+        (Record.ats — the INTENDED start, so queueing under overload
+        shows up as latency instead of being coordinated-omission'd
+        away):
+          ingress — admission -> the serve loop fetches the record
+          plan    — host batch planning (session plan_s delta, charged
+                    to every order in the batch)
+          device  — dispatch + device fetch (dispatch_s + fetch_s)
+          produce — MatchOut produce wall time for the batch
+          e2e     — admission -> the batch's outputs are visible
+          consume — admission -> a consumer's fetch delivers the
+                    MatchOut record (observed broker-side via
+                    deliver_observer, since serve hosts the broker)
+        """
+        from kme_tpu.telemetry import PhaseTimer
+
+        t = self.telemetry
+        self._lat = {
+            s: t.latency(f"lat_{s}", h) for s, h in (
+                ("ingress", "broker admission to serve-loop fetch"),
+                ("plan", "host batch planning"),
+                ("device", "device dispatch + fetch"),
+                ("produce", "MatchOut produce wall time"),
+                ("e2e", "broker admission to produce visible"),
+                ("consume", "broker admission to consumer delivery"),
+            )}
+        # serve-side spans land on their own trace track when a
+        # TraceRecorder is installed (kme-serve --trace-out)
+        self._ptimer = PhaseTimer(track="serve")
+        self._batch_ordinal = 0
+        self._last_produce_s = 0.0
+        self._phase_snap = {}
+        if self._slo_arg is not None:
+            from kme_tpu.telemetry.slo import SLO
+
+            self.slo = SLO(t, **self._slo_arg)
+        # consume-stage visibility: serve hosts the broker, so consumer
+        # receipt of MatchOut records is observable in-process
+        if getattr(self.broker, "deliver_observer", None) is None \
+                and hasattr(self.broker, "deliver_observer"):
+            lat_consume = self._lat["consume"]
+
+            def _on_deliver(topic, recs, now_us):
+                if topic != TOPIC_OUT:
+                    return
+                for r in recs:
+                    ats = getattr(r, "ats", None)
+                    if ats is not None:
+                        lat_consume.observe(max(0, now_us - ats) * 1e-6)
+
+            self.broker.deliver_observer = _on_deliver
 
     # ------------------------------------------------------------------
     # durability: snapshot at batch boundaries, resume = load + replay
@@ -546,21 +608,40 @@ class MatchService:
             return 0
         if not recs:
             return 0
-        msgs, offs, drops = [], [], []
+        import time as _t
+
+        fetch_us = _t.time_ns() // 1000
+        lat = self._lat
+        msgs, offs, drops, atss = [], [], [], []
         for r in recs:
+            ats = getattr(r, "ats", None)
+            if ats is not None:
+                # ingress = broker admission -> this fetch; per-record,
+                # from the intended-start stamp
+                lat["ingress"].observe(max(0, fetch_us - ats) * 1e-6)
             m = self._parse(r.value)
             if m is not None:
                 msgs.append(m)
                 offs.append(r.offset)
+                atss.append(ats)
             else:
                 drops.append((-1, r.offset))
         out = reasons = None
+        self._batch_ordinal += 1
+        self._last_produce_s = 0.0
+        phases = getattr(self._session, "phases", None)
+        p0 = dict(phases) if phases is not None else {}
+        t_engine0 = _t.perf_counter()
         if msgs:
             if self._native is not None:
-                out = self._native_produce(msgs)
+                with self._ptimer.phase("serve_engine"):
+                    self._flow("s")
+                    out = self._native_produce(msgs)
             elif self._session is not None:
                 try:
-                    out = self._session.process_wire(msgs)
+                    with self._ptimer.phase("serve_engine"):
+                        self._flow("s")
+                        out = self._session.process_wire(msgs)
                 except Exception as e:
                     from kme_tpu.runtime.seqsession import \
                         UnsupportedJavaOp
@@ -582,16 +663,65 @@ class MatchService:
             else:
                 from kme_tpu.wire import dumps_order
 
-                out = [[f"{rec.key} {dumps_order(rec.value)}"
-                        for rec in self._oracle.process(m)]
-                       for m in msgs]
+                with self._ptimer.phase("serve_engine"):
+                    self._flow("s")
+                    out = [[f"{rec.key} {dumps_order(rec.value)}"
+                            for rec in self._oracle.process(m)]
+                           for m in msgs]
                 self._produce_lines(out)
             if self.annotate_rejects and out is not None:
                 self._produce_rej_annotations(out, reasons)
+        # -- latency attribution: charge the batch's stage wall times to
+        # every order in it (per-order quantiles), e2e from each
+        # record's own admission stamp
+        done_us = _t.time_ns() // 1000
+        n = len(msgs)
+        plan_d = dev_d = 0.0
+        if n:
+            if phases is not None:
+                p1 = self._session.phases if self._session is not None \
+                    else p0
+                plan_d = p1.get("plan_s", 0.0) - p0.get("plan_s", 0.0)
+                dev_d = (p1.get("dispatch_s", 0.0) + p1.get("fetch_s", 0.0)
+                         - p0.get("dispatch_s", 0.0) - p0.get("fetch_s", 0.0))
+            else:
+                # host engines (native/oracle) have no plan/device
+                # split; the whole engine wall is "device" time
+                dev_d = max(0.0, _t.perf_counter() - t_engine0
+                            - self._last_produce_s)
+            if plan_d > 0:
+                lat["plan"].observe(plan_d, n)
+            if dev_d > 0:
+                lat["device"].observe(dev_d, n)
+                self.telemetry.gauge(
+                    "device_ms_per_batch",
+                    "device wall time of the last batch").set(
+                    round(dev_d * 1e3, 3))
+            if self._last_produce_s > 0:
+                lat["produce"].observe(self._last_produce_s, n)
+            for ats in atss:
+                if ats is not None:
+                    lat["e2e"].observe(max(0, done_us - ats) * 1e-6)
         if self.journal is not None and (out or drops):
             self.journal.record_batch(out or [], reasons=reasons,
                                       offsets=offs[:len(out or [])],
                                       drops=drops)
+        if self.journal is not None and n:
+            # full batch wall per order (what the order EXPERIENCED —
+            # same convention as the histograms above), not an
+            # amortized per-order share
+            plan_us = int(plan_d * 1e6)
+            dev_us = int(dev_d * 1e6)
+            prod_us = int(self._last_produce_s * 1e6)
+            self.journal.record_latency(
+                [{"off": offs[i], "oid": int(msgs[i].oid),
+                  "in_us": (max(0, fetch_us - atss[i])
+                            if atss[i] is not None else 0),
+                  "plan_us": plan_us, "dev_us": dev_us,
+                  "prod_us": prod_us,
+                  "e2e_us": (max(0, done_us - atss[i])
+                             if atss[i] is not None else 0)}
+                 for i in range(n)], batch=self._batch_ordinal)
         # batch-boundary commit (H5): offsets advance only after the
         # outputs for the whole batch are on MatchOut
         self.offset = recs[-1].offset + 1
@@ -625,11 +755,23 @@ class MatchService:
         if shed is not None:
             t.gauge("overload_rejects").set(shed)
         self._publish_eos_gauges()
+        if self.journal is not None:
+            t.gauge("journal_last_offset",
+                    "input offset of the newest committed journal "
+                    "record").set(self.journal.last_offset)
+            t.gauge("journal_lag_bytes",
+                    "bytes accepted by the journal but not yet "
+                    "committed by its writer").set(self.journal.lag_bytes)
         now = time.monotonic()
-        if self._session is not None and now - self._last_engine_pub >= 1.0:
+        if now - self._last_engine_pub >= 1.0:
             self._last_engine_pub = now
-            self._session.metrics()      # publishes counters + gauges
-            self._session.histograms()   # publishes bucket counts
+            if self._session is not None:
+                self._session.metrics()   # publishes counters + gauges
+                self._session.histograms()  # publishes bucket counts
+            if self.slo is not None:
+                # SLO degradation rides the same heartbeat channel as
+                # an audit violation; the auditor's verdict wins
+                self._slo_reason = self.slo.evaluate()
 
     def _publish_eos_gauges(self) -> None:
         """Exactly-once observability (cheap broker-attribute reads;
@@ -687,11 +829,29 @@ class MatchService:
                 time.sleep(delay)
                 delay = min(delay * 2, 1.0)
 
+    def _flow(self, phase: str) -> None:
+        """Trace flow arrow endpoint for the current batch: "s" inside
+        the engine span, "f" inside the produce span — Perfetto draws
+        the causality arrow submit -> produce across tracks."""
+        from kme_tpu.telemetry import get_tracer
+
+        tr = get_tracer()
+        if tr is not None:
+            tr.flow("batch", phase, self._batch_ordinal, track="serve")
+
     def _produce_lines(self, out) -> None:
-        for lines in out:
-            for ln in lines:
-                key, _, value = ln.partition(" ")
-                self._produce_retry(TOPIC_OUT, key, value, stamp=True)
+        import time as _t
+
+        t0 = _t.perf_counter()
+        with self._ptimer.phase("serve_produce"):
+            self._flow("f")
+            for lines in out:
+                for ln in lines:
+                    key, _, value = ln.partition(" ")
+                    self._produce_retry(TOPIC_OUT, key, value, stamp=True)
+        # accumulates across the branch paths that produce more than
+        # once per step (native partial + REJ annotations)
+        self._last_produce_s += _t.perf_counter() - t0
 
     def _native_produce(self, msgs):
         # byte-faithful death handling: forward every completed
@@ -864,7 +1024,7 @@ class MatchService:
             json.dump({"pid": os.getpid(), "time": _t.time(),
                        "seen": seen, "offset": self.offset,
                        "tick": tick, "closing": closing,
-                       "degraded": self.degraded,
+                       "degraded": self.degraded or self._slo_reason,
                        "role": "follower" if self.follower else "leader",
                        "epoch": self.epoch,
                        "metrics": self.telemetry.snapshot()}, f)

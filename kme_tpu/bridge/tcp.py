@@ -18,7 +18,10 @@ object per line — carrying the three broker operations:
 
 Exactly-once produces additionally carry "epoch" and "out_seq" keys
 (optional — absent means the unstamped at-least-once path); fetch rows
-for stamped records come back as [o,k,v,epoch,out_seq].
+for stamped records come back as [o,k,v,epoch,out_seq], and rows whose
+record carries a broker-admission timestamp append a sixth element:
+[o,k,v,epoch,out_seq,ats] (microseconds, wall clock). Clients parse by
+length, so old/new peers interoperate.
 
 Errors come back as {"ok":false,"error":"..."}; the client raises
 BrokerError (BrokerOverload when the reply carries
@@ -41,6 +44,18 @@ from kme_tpu import faults
 from kme_tpu.bridge.broker import (BrokerError, BrokerFenced,
                                    BrokerOverload, InProcessBroker,
                                    Record)
+
+
+def _row(r: Record) -> list:
+    """Wire row for a fetched record — the shortest shape that loses
+    nothing: [o,k,v], +[epoch,out_seq] when stamped, +[ats] when the
+    broker recorded an admission time."""
+    ats = getattr(r, "ats", None)
+    if ats is not None:
+        return [r.offset, r.key, r.value, r.epoch, r.out_seq, ats]
+    if r.epoch is None and r.out_seq is None:
+        return [r.offset, r.key, r.value]
+    return [r.offset, r.key, r.value, r.epoch, r.out_seq]
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -77,13 +92,9 @@ class _Handler(socketserver.StreamRequestHandler):
                         req["topic"], int(req["offset"]),
                         int(req.get("max", 1024)),
                         float(req.get("timeout_ms", 0)) / 1e3)
-                    resp = {"ok": True,
-                            "records": [
-                                [r.offset, r.key, r.value]
-                                if r.epoch is None and r.out_seq is None
-                                else [r.offset, r.key, r.value,
-                                      r.epoch, r.out_seq]
-                                for r in recs]}
+                    # rows: [o,k,v] bare, [o,k,v,epoch,out_seq] stamped,
+                    # [o,k,v,epoch,out_seq,ats] with an admission stamp
+                    resp = {"ok": True, "records": [_row(r) for r in recs]}
                 elif op == "fence":
                     broker.fence(int(req["epoch"]))
                     resp = {"ok": True}
@@ -238,7 +249,8 @@ class TcpBroker:
                           extra_wait=timeout)
         return [Record(row[0], row[1], row[2],
                        row[3] if len(row) > 3 else None,
-                       row[4] if len(row) > 4 else None)
+                       row[4] if len(row) > 4 else None,
+                       row[5] if len(row) > 5 else None)
                 for row in resp["records"]]
 
     def end_offset(self, topic: str) -> int:

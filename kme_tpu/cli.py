@@ -147,7 +147,8 @@ def _fmt_event(ev: dict) -> str:
             f"b={ev.get('b', '?')}:{ev.get('i', '?')}",
             f"off={ev.get('off', -1)}",
             f"{ev['e']:<13s}"]
-    for k in ("oid", "aid", "sid", "px", "qty", "moid", "maid"):
+    for k in ("oid", "aid", "sid", "px", "qty", "moid", "maid",
+              "in_us", "plan_us", "dev_us", "prod_us", "e2e_us"):
         if k in ev:
             bits.append(f"{k}={ev[k]}")
     if ev.get("rej"):
@@ -355,6 +356,16 @@ def standby_main(argv=None) -> int:
     return _main(argv)
 
 
+def top_main(argv=None) -> int:
+    """Live operations dashboard over the /metrics.json surfaces of a
+    leader, an optional standby, and the supervisor state file."""
+    try:
+        from kme_tpu.telemetry.top import main as _main
+    except ImportError:
+        return _not_yet("the kme-top dashboard")
+    return _main(argv)
+
+
 def chaos_main(argv=None) -> int:
     """Deterministic fault-injection runs (kme-supervise + KME_FAULTS)
     with byte-exact MatchOut verification against the oracle."""
@@ -369,7 +380,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m kme_tpu.cli")
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
-        "supervise", "standby", "trace", "chaos"))
+        "supervise", "standby", "trace", "chaos", "top"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
@@ -378,6 +389,7 @@ def main(argv=None) -> int:
             "consume": consume_main, "provision": provision_main,
             "supervise": supervise_main, "standby": standby_main,
             "trace": trace_main, "chaos": chaos_main,
+            "top": top_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

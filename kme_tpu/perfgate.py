@@ -1,0 +1,201 @@
+"""Perf-regression gate over recorded benchmark artifacts.
+
+`kme-bench --baseline BENCH.json --gate` runs the bench, then compares
+its detail metrics against a recorded baseline and exits non-zero on a
+regression beyond the noise tolerance. CI wires this against the
+repo's BENCH_r0N.json artifacts.
+
+Two artifact realities shape the loader:
+
+- The recorded baselines hold the bench's stderr under a "tail" key
+  that is the LAST N BYTES of the stream — routinely TRUNCATED
+  mid-JSON (BENCH_r05.json starts mid-object). So metrics are
+  extracted with a `"name": number` regex over the raw text, never by
+  parsing the whole document; the first occurrence wins (the root
+  detail object precedes the nested java/ sub-dicts that repeat metric
+  names).
+- Baselines may be recorded on a different backend (the checked-in
+  ones are TPU; CI gates on CPU). Cross-backend magnitudes are not
+  comparable, so a backend mismatch demotes the gate to ADVISORY:
+  the report is still printed/written, but the exit code stays 0.
+
+Direction matters: throughput regresses by FALLING, latency by RISING.
+`pipeline_speedup` and overlap fractions are excluded from
+enforcement — they are ratios of two noisy quantities and flap across
+runs (the bench already emits pipeline_warning for visibility).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional
+
+# metric name -> direction ("up" = bigger is better, "down" = smaller
+# is better). Anything not listed is reported but never enforced.
+GATED_METRICS = {
+    "local_orders_per_sec": "up",
+    "streamed_orders_per_sec": "up",
+    "serial_orders_per_sec": "up",
+    "orders_per_sec": "up",
+    "engine_side_p50_ms": "down",
+    "engine_side_p90_ms": "down",
+    "engine_side_p99_ms": "down",
+    "device_ms_per_batch": "down",
+    "p50_ms": "down",
+    "p90_ms": "down",
+    "p99_ms": "down",
+}
+
+# reported-only: too noisy to gate on (documented flappers)
+ADVISORY_METRICS = ("pipeline_speedup", "measured_overlap_frac",
+                    "journal_overhead_frac")
+
+_NUM = r"(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
+
+
+def extract_metrics(text: str) -> Dict[str, float]:
+    """Regex-scrape `"name": number` pairs from artifact text.
+
+    Tolerates truncated JSON (recorded tails start mid-object). First
+    occurrence of each name wins — the root detail object precedes the
+    nested sub-dicts (e.g. "java": {...}) that reuse metric names."""
+    out: Dict[str, float] = {}
+    for m in re.finditer(rf'"([A-Za-z_][A-Za-z0-9_]*)"\s*:\s*{_NUM}',
+                         text):
+        name, val = m.group(1), float(m.group(2))
+        if name not in out:
+            out[name] = val
+    return out
+
+
+def extract_backend(text: str) -> Optional[str]:
+    m = re.search(r'"backend"\s*:\s*"([a-z]+)"', text)
+    return m.group(1) if m else None
+
+
+def load_artifact(path: str) -> Dict:
+    """Load a benchmark artifact into {"metrics", "backend", "source"}.
+
+    Accepts any of: a recorded driver artifact {"cmd","rc","tail",...}
+    (metrics live in the tail text), a bench detail JSON, a headline
+    JSON, or raw mixed stdout+stderr text."""
+    with open(path, encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    source = "text"
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and isinstance(doc.get("tail"), str):
+        text = doc["tail"]
+        source = "driver-tail"
+    elif doc is not None:
+        source = "json"
+    return {"metrics": extract_metrics(text),
+            "backend": extract_backend(text), "source": source}
+
+
+def detail_to_artifact(detail: dict) -> Dict:
+    """Adapt a live bench `detail` dict to the artifact shape."""
+    text = json.dumps(detail)
+    return {"metrics": extract_metrics(text),
+            "backend": extract_backend(text), "source": "live"}
+
+
+def compare(baseline: Dict, current: Dict,
+            tolerance: float = 0.25) -> Dict:
+    """Direction-aware comparison of two artifacts.
+
+    A gated metric regresses when it is worse than baseline by more
+    than `tolerance` (fractional: 0.25 allows a 25 % degradation
+    before failing — wide enough for shared-CI noise, far inside the
+    2x slowdown the gate exists to catch). Returns a report dict;
+    `ok` is False only when a gated metric regressed AND the backends
+    match (else `advisory` is True and exit stays 0)."""
+    bm, cm = baseline["metrics"], current["metrics"]
+    rows: List[dict] = []
+    regressions: List[str] = []
+    for name, direction in GATED_METRICS.items():
+        if name not in bm or name not in cm:
+            continue
+        base, cur = bm[name], cm[name]
+        if base <= 0:
+            continue
+        ratio = cur / base
+        # normalize so ratio > 1 always means WORSE
+        worse = 1.0 / ratio if direction == "up" else ratio
+        status = "ok"
+        if worse > 1.0 + tolerance:
+            status = "regressed"
+            regressions.append(name)
+        rows.append({"name": name, "direction": direction,
+                     "baseline": base, "current": cur,
+                     "ratio": round(ratio, 4), "status": status})
+    for name in ADVISORY_METRICS:
+        if name in bm and name in cm:
+            rows.append({"name": name, "direction": "advisory",
+                         "baseline": bm[name], "current": cm[name],
+                         "ratio": (round(cm[name] / bm[name], 4)
+                                   if bm[name] else None),
+                         "status": "advisory"})
+    mismatch = (baseline.get("backend") and current.get("backend")
+                and baseline["backend"] != current["backend"])
+    return {
+        "tolerance": tolerance,
+        "baseline_backend": baseline.get("backend"),
+        "current_backend": current.get("backend"),
+        "backend_mismatch": bool(mismatch),
+        "advisory": bool(mismatch),
+        "compared": len(rows),
+        "regressions": regressions,
+        "metrics": rows,
+        "ok": not regressions or bool(mismatch),
+    }
+
+
+def format_report(report: Dict) -> str:
+    lines = []
+    for row in report["metrics"]:
+        mark = {"ok": " ", "regressed": "!", "advisory": "~"}[
+            row["status"]]
+        lines.append(
+            f"{mark} {row['name']:<28s} base={row['baseline']:<14g} "
+            f"cur={row['current']:<14g} ratio={row['ratio']}")
+    if report["backend_mismatch"]:
+        lines.append(
+            f"~ backend mismatch: baseline={report['baseline_backend']} "
+            f"current={report['current_backend']} — gate is ADVISORY "
+            f"(exit 0)")
+    if report["regressions"] and not report["advisory"]:
+        lines.append(f"! REGRESSION beyond {report['tolerance']:.0%} "
+                     f"tolerance: {', '.join(report['regressions'])}")
+    elif report["regressions"]:
+        lines.append(f"~ would-be regressions (advisory): "
+                     f"{', '.join(report['regressions'])}")
+    else:
+        lines.append(f"gate clean: {report['compared']} metric(s) "
+                     f"within {report['tolerance']:.0%}")
+    return "\n".join(lines)
+
+
+def run_gate(baseline_path: str, current: Dict,
+             tolerance: float = 0.25,
+             report_path: Optional[str] = None) -> int:
+    """Compare, print, optionally persist the report; return the exit
+    code (0 clean/advisory, 1 regression, 2 unusable baseline)."""
+    import sys
+
+    baseline = load_artifact(baseline_path)
+    if not baseline["metrics"]:
+        print(f"kme-bench --gate: no metrics found in "
+              f"{baseline_path!r}; cannot gate", file=sys.stderr)
+        return 2
+    report = compare(baseline, current, tolerance=tolerance)
+    print(format_report(report), file=sys.stderr)
+    if report_path is not None:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"kme-bench --gate: report written to {report_path}",
+              file=sys.stderr)
+    return 0 if report["ok"] else 1

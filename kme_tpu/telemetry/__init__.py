@@ -1,19 +1,26 @@
 """Unified telemetry: metric registry, phase tracing, live HTTP surface,
 order-lifecycle flight recorder, and continuous invariant auditing.
 
-- registry: Counter/Gauge/Histogram + Prometheus text + JSON export
-- trace: PhaseTimer spans + Chrome trace-event recording
+- registry: Counter/Gauge/Histogram/LatencyHistogram + Prometheus text
+  + JSON export
+- trace: PhaseTimer spans + Chrome trace-event recording (incl. flow
+  arrows)
 - httpd: stdlib /metrics endpoint over a Registry
 - journal: append-only lifecycle journal (jsonl/binary) + readers
 - audit: shadow-ledger invariant auditor over the journal
+- slo: error-budget objectives over the live latency histograms
+- top: the kme-top live operations dashboard
 """
 
 from kme_tpu.telemetry.registry import (  # noqa: F401
     BUCKET_LE,
+    LAT_BOUNDS,
+    LAT_N_BUCKETS,
     N_BUCKETS,
     Counter,
     Gauge,
     Histogram,
+    LatencyHistogram,
     Registry,
     bucket_index,
 )
@@ -39,3 +46,4 @@ from kme_tpu.telemetry.audit import (  # noqa: F401
     Violation,
     replay_repro,
 )
+from kme_tpu.telemetry.slo import SLO  # noqa: F401

@@ -161,8 +161,8 @@ class InvariantAuditor:
 
     def _apply(self, ev: dict, out: List[Violation]) -> None:
         e = ev["e"]
-        if e in ("win", "drop", "reject"):
-            return
+        if e in ("win", "lat", "drop", "reject"):
+            return      # timing/terminal records — no ledger effect
         if e == "submit":
             self._finalize_pending(out)
             return

@@ -23,14 +23,18 @@ def start_metrics_server(registry, port: int, host: str = "0.0.0.0"):
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib API name)
             path = self.path.split("?", 1)[0]
-            if path in ("/metrics", "/"):
-                body = registry.prometheus_text().encode()
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
-            elif path == "/metrics.json":
-                body = registry.to_json().encode()
-                ctype = "application/json"
-            else:
-                self.send_error(404)
+            try:
+                if path in ("/metrics", "/"):
+                    body = registry.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/metrics.json":
+                    body = registry.to_json().encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+            except Exception as e:   # a broken metric must not 200-empty
+                self.send_error(500, explain=str(e))
                 return
             self.send_response(200)
             self.send_header("Content-Type", ctype)

@@ -20,7 +20,7 @@ the operator wants it.
 Event dictionaries (canonical keys; absent keys mean not-applicable):
 
   e    event type: submit accept reject rest fill cancel create
-       transfer payout add_symbol remove_symbol drop win
+       transfer payout add_symbol remove_symbol drop win lat
   seq  engine-global event sequence number (monotonic, survives resume)
   ts   wall clock, microseconds since epoch
   b    batch id (monotonic per journal)
@@ -35,6 +35,12 @@ Event dictionaries (canonical keys; absent keys mean not-applicable):
   rej  reason code (wire.REJ_*) on reject/drop events
   kind/t0/t1   on win (pipeline window) events: "submit"|"collect" and
        the window bounds in integer microseconds
+  in_us/plan_us/dev_us/prod_us/e2e_us   on lat (stage-attribution)
+       events: per-order microseconds spent in broker ingress wait,
+       batch plan, device dispatch+fetch, produce-visible, and the
+       arrival->visible total (ingress is per-order from the broker
+       arrival stamp; plan/device/produce are the enclosing batch's
+       stage walls — every order in a batch shares them)
 
 `batch_events` is the single wire->events derivation; the oracle replay
 (`oracle_events`) reuses it on the Python reference engine's output so a
@@ -56,7 +62,7 @@ from kme_tpu.wire import (REJ_MALFORMED, REJ_UNSPECIFIED, parse_order,
 
 ETYPES = ("submit", "accept", "reject", "rest", "fill", "cancel",
           "create", "transfer", "payout", "add_symbol", "remove_symbol",
-          "drop", "win")
+          "drop", "win", "lat")
 _ETYPE_IDX = {n: i for i, n in enumerate(ETYPES)}
 
 _ACT_EVENT = {
@@ -136,8 +142,9 @@ def batch_events(lines_per_msg: Sequence[Sequence[str]],
 
 
 def canonical_events(events: Iterable[dict]) -> List[dict]:
-    """Provenance-independent view for replay comparison: window events
-    dropped; seq/ts/b/i/sh/rej stripped (batching, wall clock and
+    """Provenance-independent view for replay comparison: window and
+    latency-stamp events dropped (both are recorder-local timing, not
+    lifecycle); seq/ts/b/i/sh/rej stripped (batching, wall clock and
     reason granularity differ between recorders; the lifecycle payload
     and the input offset alignment must not). Events are stably
     ordered by input offset — batching also decides WHERE a drop
@@ -146,7 +153,7 @@ def canonical_events(events: Iterable[dict]) -> List[dict]:
     byte-for-byte."""
     out = []
     for ev in events:
-        if ev.get("e") == "win":
+        if ev.get("e") in ("win", "lat"):
             continue
         out.append({k: v for k, v in ev.items()
                     if k not in ("seq", "ts", "b", "i", "sh", "rej")})
@@ -206,6 +213,15 @@ def _encode(ev: dict) -> bytes:
                          ev.get("sh", 0), 0, 0, ev.get("b", -1), -1,
                          ev.get("seq", 0), ev.get("ts", 0), -1,
                          ev["t0"], ev["t1"], 0, 0, 0, 0, 0)
+    if ev["e"] == "lat":
+        # stage micro-durations ride the spare int64 slots (aid/sid/
+        # px/qty/moid) — same 96-byte framing, no format version bump
+        return _REC.pack(
+            e, 0, ev.get("sh", 0), 0, 0, ev.get("b", -1), -1,
+            ev.get("seq", 0), ev.get("ts", 0), ev.get("off", -1),
+            ev.get("oid", 0), ev.get("in_us", 0), ev.get("plan_us", 0),
+            ev.get("dev_us", 0), ev.get("prod_us", 0),
+            ev.get("e2e_us", 0), 0)
     return _REC.pack(
         e, ev.get("rej", 0), ev.get("sh", 0), 0, ev.get("act", 0),
         ev.get("b", 0), ev.get("i", -1), ev.get("seq", 0),
@@ -221,6 +237,10 @@ def _decode(buf: bytes) -> dict:
     ev = {"e": name, "seq": seq, "ts": ts, "b": b, "sh": sh}
     if name == "win":
         ev.update(kind=_WIN_KINDS[rej], t0=oid, t1=aid)
+        return ev
+    if name == "lat":
+        ev.update(off=off, oid=oid, in_us=aid, plan_us=sid,
+                  dev_us=px, prod_us=qty, e2e_us=moid)
         return ev
     ev.update(i=i, off=off)
     if name == "drop":
@@ -334,6 +354,13 @@ class Journal:
         self._seq = 0
         self._batch = 0
         self._lock = threading.Lock()
+        # writer-lag instrumentation (heartbeat gauges): payload bytes
+        # enqueued but not yet committed (a wedged async worker shows
+        # up here long before the disk fills), and the highest input
+        # offset a committed event carried
+        self._lag_lock = threading.Lock()
+        self._pending_bytes = 0
+        self.last_offset = -1
         if resume and os.path.exists(path) and os.path.getsize(path):
             self._resume_tail()
         self._f = open(path, "ab")
@@ -389,10 +416,11 @@ class Journal:
         fan-out all happen on the worker thread in FIFO order (so seq
         and batch numbering stay deterministic)."""
         job = ("batch", lines_per_msg, reasons, offsets, tuple(drops))
-        if self._q is not None:
-            self._q.put(job)
-        else:
-            self._commit(job)
+        # payload estimate for lag_bytes: the wire lines dominate the
+        # encoded size in either framing
+        est = sum(len(ln) + 1 for lines in lines_per_msg
+                  for ln in lines)
+        self._submit(job, est)
 
     def record_window(self, kind: str, t0: float, t1: float,
                       batch: Optional[int] = None) -> None:
@@ -401,28 +429,55 @@ class Journal:
         microseconds. `batch` tags the pipeline batch index."""
         job = ("win", kind, int(t0 * 1e6), int(t1 * 1e6),
                -1 if batch is None else batch)
-        if self._q is not None:
-            self._q.put(job)
-        else:
-            self._commit(job)
+        self._submit(job, REC_SIZE)
+
+    def record_latency(self, entries: Sequence[dict],
+                       batch: Optional[int] = None) -> None:
+        """Append per-order stage-attribution stamps ("lat" events).
+        Each entry carries off/oid plus in_us/plan_us/dev_us/prod_us/
+        e2e_us microsecond durations (see module docstring). Dropped
+        from the canonical form, so `kme-trace --verify` still
+        byte-agrees with the oracle replay."""
+        job = ("lat", tuple(dict(e) for e in entries),
+               -1 if batch is None else batch)
+        self._submit(job, REC_SIZE * len(entries))
 
     def append_events(self, events: List[dict]) -> None:
         """Stamp + append pre-derived events (one batch's worth)."""
         job = ("events", events)
-        if self._q is not None:
-            self._q.put(job)
-        else:
-            self._commit(job)
+        self._submit(job, REC_SIZE * len(events))
 
     # -- worker / commit ------------------------------------------------
 
+    def _submit(self, job, est: int) -> None:
+        with self._lag_lock:
+            self._pending_bytes += est
+        if self._q is not None:
+            self._q.put((job, est))
+        else:
+            self._commit_job(job, est)
+
+    def _commit_job(self, job, est: int) -> None:
+        try:
+            self._commit(job)
+        finally:
+            with self._lag_lock:
+                self._pending_bytes -= est
+
+    @property
+    def lag_bytes(self) -> int:
+        """Estimated payload bytes enqueued but not yet written."""
+        with self._lag_lock:
+            return self._pending_bytes
+
     def _drain(self) -> None:
         while True:
-            job = self._q.get()
-            if job is None:
+            item = self._q.get()
+            if item is None:
                 return
+            job, est = item
             try:
-                self._commit(job)
+                self._commit_job(job, est)
             except Exception as e:  # pragma: no cover - defensive
                 import sys
 
@@ -442,6 +497,9 @@ class Journal:
                 _, kind, t0, t1, b = job
                 events = [{"e": "win", "kind": kind, "t0": t0,
                            "t1": t1}]
+            elif job[0] == "lat":
+                _, entries, b = job
+                events = [dict(ev, e="lat") for ev in entries]
             else:
                 _, events = job
                 b = self._batch
@@ -453,6 +511,10 @@ class Journal:
                 ev["ts"] = ts
                 ev["sh"] = self.shard
             self._write(events)
+            for ev in events:
+                off = ev.get("off", -1)
+                if off is not None and off > self.last_offset:
+                    self.last_offset = off
         for obs in self.observers:
             obs(events, lines)
 
@@ -596,8 +658,10 @@ class Journal:
             if kept:
                 self._seq = max(ev["seq"] for ev in kept) + 1
                 self._batch = max(ev.get("b", -1) for ev in kept) + 1
+                self.last_offset = max(ev.get("off", -1) for ev in kept)
             else:
                 self._seq = self._batch = 0
+                self.last_offset = -1
             self._f = open(self.path, "ab")
 
 

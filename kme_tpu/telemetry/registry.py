@@ -18,6 +18,7 @@ exact only for host-side `observe()` use.
 
 from __future__ import annotations
 
+import bisect
 import json
 import threading
 
@@ -107,6 +108,105 @@ class Histogram:
         return sum(self.buckets)
 
 
+# -- streaming latency quantiles --------------------------------------------
+#
+# Fixed log-spaced buckets: 1 µs doubling up to ~67 s, one overflow
+# bucket. 27 boundaries + overflow = 28 counts; a full histogram is a
+# few hundred bytes, so every stage of the serving pipeline can afford
+# one that is ALWAYS on (the bench's sort-all-samples percentiles need
+# the whole sample vector; this needs O(1) memory and O(1) observe).
+
+LAT_N_BUCKETS = 28
+LAT_BOUNDS = tuple(1e-6 * (1 << i) for i in range(LAT_N_BUCKETS - 1))
+
+
+class LatencyHistogram:
+    """Streaming quantile estimator over log-spaced duration buckets.
+
+    Values are SECONDS. `observe(v, n)` records the same duration for n
+    orders at once — batch-granular stages (plan, device, produce)
+    charge the batch's wall time to every order in it, so the quantiles
+    reflect per-order experience, not per-batch. Callers must pass
+    intended-start-based durations (arrival stamps, not dequeue times)
+    to stay coordinated-omission-safe.
+
+    Thread-safe: observe() and the snapshot/quantile readers take the
+    instance lock, so an HTTP scrape mid-batch sees a consistent
+    (count, sum, buckets) triple."""
+
+    kind = "latency"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._counts = [0] * LAT_N_BUCKETS
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, seconds: float, n: int = 1) -> None:
+        if n <= 0:
+            return
+        i = bisect.bisect_left(LAT_BOUNDS, seconds)
+        with self._lock:
+            self._counts[i] += n
+            self._count += n
+            self._sum += seconds * n
+
+    # -- readers (each takes one consistent view under the lock) -------
+
+    def state(self) -> tuple:
+        """(count, sum, bucket-counts copy) — one atomic view."""
+        with self._lock:
+            return self._count, self._sum, list(self._counts)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @staticmethod
+    def _quantile_from(counts, total, q: float) -> float:
+        if total <= 0:
+            return 0.0
+        target = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo = 0.0 if i == 0 else LAT_BOUNDS[i - 1]
+                hi = (LAT_BOUNDS[i] if i < len(LAT_BOUNDS)
+                      else 2 * LAT_BOUNDS[-1])
+                frac = (target - cum) / c
+                return lo + (hi - lo) * frac
+            cum += c
+        return 2 * LAT_BOUNDS[-1]
+
+    def quantile(self, q: float) -> float:
+        count, _s, counts = self.state()
+        return self._quantile_from(counts, count, q)
+
+    def quantiles(self) -> dict:
+        """{0.5: s, 0.9: s, 0.99: s, 0.999: s} from ONE atomic view."""
+        count, _s, counts = self.state()
+        return {q: self._quantile_from(counts, count, q)
+                for q in (0.5, 0.9, 0.99, 0.999)}
+
+    def count_over(self, threshold_s: float) -> int:
+        """Observations in buckets wholly above `threshold_s` — the
+        SLO module's bad-event counter (bucket-conservative: the
+        threshold's own bucket counts as good)."""
+        i = bisect.bisect_left(LAT_BOUNDS, threshold_s)
+        with self._lock:
+            return sum(self._counts[i + 1:])
+
+
 def _sanitize(name: str) -> str:
     out = []
     for i, ch in enumerate(name):
@@ -148,6 +248,9 @@ class Registry:
     def histogram(self, name: str, help: str = "") -> Histogram:
         return self._get(Histogram, name, help)
 
+    def latency(self, name: str, help: str = "") -> LatencyHistogram:
+        return self._get(LatencyHistogram, name, help)
+
     # -- bulk publication (the session metrics()/histograms() projection)
 
     def publish_counters(self, counters: dict) -> None:
@@ -177,7 +280,10 @@ class Registry:
             q = self._qualified(name)
             if m.help:
                 lines.append(f"# HELP {q} {m.help}")
-            lines.append(f"# TYPE {q} {m.kind}")
+            # latency histograms expose as Prometheus summaries
+            # (pre-computed quantiles, no bucket series)
+            lines.append(f"# TYPE {q} "
+                         f"{'summary' if m.kind == 'latency' else m.kind}")
             if m.kind == "histogram":
                 cum = 0
                 for le, c in zip(BUCKET_LE, m.buckets):
@@ -185,6 +291,15 @@ class Registry:
                     lines.append(f'{q}_bucket{{le="{le}"}} {cum}')
                 lines.append(f"{q}_sum {m.sum}")
                 lines.append(f"{q}_count {cum}")
+            elif m.kind == "latency":
+                # summary exposition: one atomic state() view feeds
+                # every quantile line plus sum/count
+                count, total, counts = m.state()
+                for qq in (0.5, 0.9, 0.99, 0.999):
+                    v = m._quantile_from(counts, count, qq)
+                    lines.append(f'{q}{{quantile="{qq}"}} {v:.6g}')
+                lines.append(f"{q}_sum {total:.6g}")
+                lines.append(f"{q}_count {count}")
             else:
                 lines.append(f"{q} {m.value}")
         return "\n".join(lines) + "\n"
@@ -196,12 +311,27 @@ class Registry:
         """Plain-dict view: {"counters": {...}, "gauges": {...},
         "histograms": {name: {"buckets", "sum", "count"}}}."""
         with self._lock:
-            out = {"counters": {}, "gauges": {}, "histograms": {}}
+            out = {"counters": {}, "gauges": {}, "histograms": {},
+                   "latencies": {}}
             for name, m in self._metrics.items():
                 if m.kind == "counter":
                     out["counters"][name] = m.value
                 elif m.kind == "gauge":
                     out["gauges"][name] = m.value
+                elif m.kind == "latency":
+                    count, total, counts = m.state()
+                    out["latencies"][name] = {
+                        "count": count,
+                        "sum_s": round(total, 6),
+                        "p50_ms": round(m._quantile_from(
+                            counts, count, 0.5) * 1e3, 3),
+                        "p90_ms": round(m._quantile_from(
+                            counts, count, 0.9) * 1e3, 3),
+                        "p99_ms": round(m._quantile_from(
+                            counts, count, 0.99) * 1e3, 3),
+                        "p999_ms": round(m._quantile_from(
+                            counts, count, 0.999) * 1e3, 3),
+                    }
                 else:
                     out["histograms"][name] = {
                         "buckets": list(m.buckets),

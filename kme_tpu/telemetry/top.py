@@ -1,0 +1,306 @@
+"""kme-top: live operations dashboard for a serving pair.
+
+One terminal view over the surfaces the serving stack already exposes —
+nothing here adds instrumentation, it only reads:
+
+- the LEADER's /metrics.json (kme-serve --metrics-port) or heartbeat
+  file (--health-file; the heartbeat embeds the same registry snapshot)
+- the STANDBY's /metrics.json (kme-standby --metrics-port) or its
+  heartbeat file
+- the SUPERVISOR's state mirror (<checkpoint-dir>/supervisor.json)
+
+Shown: input throughput (rate computed between refreshes), per-stage
+latency quantiles (ingress/plan/device/produce/e2e/consume — the
+attribution pipeline in bridge/service.py), leader epoch and offset,
+SLO state, replica application lag, and the supervisor's restart
+history. `--once` prints a single plain-text frame (scriptable; the
+smoke test uses it); the default is a curses loop that redraws every
+--interval seconds and quits on `q`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+STAGES = ("ingress", "plan", "device", "produce", "e2e", "consume")
+
+
+# -- collection --------------------------------------------------------
+
+
+def scrape(source: Optional[str], timeout: float = 1.0) -> dict:
+    """Read one node's state from a URL or a heartbeat file.
+
+    Returns {"source", "ok", "error"?, "hb"?, "metrics"} — `hb` is the
+    heartbeat dict when the source was a heartbeat file (or a metrics
+    surface that happens to embed one); `metrics` is always the
+    registry-snapshot shape ({counters, gauges, histograms,
+    latencies}), possibly empty."""
+    if not source:
+        return {"source": None, "ok": False, "metrics": {}}
+    out: dict = {"source": source, "ok": False, "metrics": {}}
+    try:
+        if source.startswith(("http://", "https://")):
+            from urllib.request import urlopen
+
+            url = source
+            if not url.rstrip("/").endswith("metrics.json"):
+                url = url.rstrip("/") + "/metrics.json"
+            with urlopen(url, timeout=timeout) as resp:
+                doc = json.loads(resp.read().decode())
+        else:
+            with open(source) as f:
+                doc = json.load(f)
+    except Exception as e:
+        out["error"] = str(e)
+        return out
+    out["ok"] = True
+    if "counters" in doc or "latencies" in doc:
+        out["metrics"] = doc          # bare registry snapshot
+    else:
+        out["hb"] = doc               # heartbeat with embedded metrics
+        out["metrics"] = doc.get("metrics") or {}
+    return out
+
+
+def read_supervisor(path: Optional[str]) -> Optional[dict]:
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def collect(leader: Optional[str], standby: Optional[str],
+            supervisor: Optional[str], now: Optional[float] = None) -> dict:
+    return {"t": time.monotonic() if now is None else now,
+            "leader": scrape(leader), "standby": scrape(standby),
+            "supervisor": read_supervisor(supervisor)}
+
+
+# -- derivation --------------------------------------------------------
+
+
+def _counter(node: dict, name: str):
+    return node.get("metrics", {}).get("counters", {}).get(name)
+
+
+def _gauge(node: dict, name: str):
+    return node.get("metrics", {}).get("gauges", {}).get(name)
+
+
+def build_view(cur: dict, prev: Optional[dict] = None) -> dict:
+    """Fold two collections into the render model: point-in-time state
+    plus rates derived from the deltas between them."""
+    view = dict(cur)
+    rate = None
+    if prev is not None:
+        dt = cur["t"] - prev["t"]
+        a = _counter(prev["leader"], "service_records")
+        b = _counter(cur["leader"], "service_records")
+        if dt > 0 and a is not None and b is not None and b >= a:
+            rate = (b - a) / dt
+    view["records_per_s"] = rate
+    lead = cur["leader"]
+    stby = cur["standby"]
+    lag = _gauge(stby, "replica_lag_records")
+    if lag is None:
+        hb = stby.get("hb") or {}
+        applied, lead_off = hb.get("applied"), hb.get("leader_offset")
+        if applied is not None and lead_off is not None:
+            lag = max(0, lead_off - applied)
+    view["replica_lag"] = lag
+    hb = lead.get("hb") or {}
+    view["degraded"] = hb.get("degraded")
+    view["epoch"] = hb.get("epoch", _gauge(lead, "leader_epoch"))
+    view["offset"] = hb.get("offset", _gauge(lead, "service_offset"))
+    return view
+
+
+# -- rendering ---------------------------------------------------------
+
+
+def _fmt(v, nd=1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.{nd}f}"
+    return f"{v:,}"
+
+
+def render(view: dict, width: int = 78) -> list:
+    """The dashboard frame as plain lines (shared by the curses loop
+    and --once; pure so the smoke test can assert on it)."""
+    lead, stby = view["leader"], view["standby"]
+    sup = view.get("supervisor")
+    bar = "=" * width
+    lines = [f"kme-top  {time.strftime('%H:%M:%S')}", bar]
+
+    rate = view.get("records_per_s")
+    lines.append(
+        f"leader   epoch={_fmt(view.get('epoch'))} "
+        f"offset={_fmt(view.get('offset'))} "
+        f"records={_fmt(_counter(lead, 'service_records'))} "
+        f"rate={_fmt(rate) + '/s' if rate is not None else '-'}")
+    if not lead["ok"]:
+        lines.append(f"  leader source unreachable: "
+                     f"{lead.get('error', 'no source')}")
+    deg = view.get("degraded")
+    slo_ok = _gauge(lead, "slo_ok")
+    burn = _gauge(lead, "slo_burn_rate")
+    if deg:
+        lines.append(f"  DEGRADED: {deg}")
+    if slo_ok is not None:
+        lines.append(
+            f"  slo={'OK' if slo_ok else 'BREACH'}"
+            + (f" burn={_fmt(burn, 2)}x" if burn is not None else ""))
+    if _gauge(lead, "pipeline_warning"):
+        lines.append("  pipeline_warning: speedup < 1.0 "
+                     "(see measured_overlap_s)")
+
+    lats = lead.get("metrics", {}).get("latencies", {})
+    rows = [(s, lats.get(f"lat_{s}")) for s in STAGES]
+    if any(v for _s, v in rows):
+        lines.append("")
+        lines.append(f"  {'stage':<9s}{'count':>10s}{'p50 ms':>10s}"
+                     f"{'p99 ms':>10s}{'p999 ms':>10s}")
+        for s, v in rows:
+            if not v:
+                continue
+            lines.append(
+                f"  {s:<9s}{_fmt(v.get('count'), 0):>10s}"
+                f"{_fmt(v.get('p50_ms'), 3):>10s}"
+                f"{_fmt(v.get('p99_ms'), 3):>10s}"
+                f"{_fmt(v.get('p999_ms'), 3):>10s}")
+
+    lines.append("")
+    if stby.get("source"):
+        hb = stby.get("hb") or {}
+        lines.append(
+            f"standby  applied={_fmt(hb.get('applied', _gauge(stby, 'replica_applied_offset')))} "
+            f"lag={_fmt(view.get('replica_lag'))} "
+            f"out_seq={_fmt(hb.get('out_seq'))} "
+            f"discarded={_fmt(hb.get('discarded'))}")
+        if not stby["ok"]:
+            lines.append(f"  standby source unreachable: "
+                         f"{stby.get('error', '?')}")
+    else:
+        lines.append("standby  (none)")
+
+    if sup is not None:
+        lines.append(
+            f"superv   restarts={_fmt(sup.get('restarts_total'))} "
+            f"budget={_fmt(sup.get('budget_used'))}/"
+            f"{_fmt(sup.get('max_restarts'))} "
+            f"standby_restarts={_fmt(sup.get('standby_restarts'))}")
+        for rec in (sup.get("recoveries") or [])[-3:]:
+            if isinstance(rec, dict):
+                lines.append("  recovery: " + " ".join(
+                    f"{k}={rec[k]}" for k in sorted(rec)))
+    lines.append(bar)
+    return lines
+
+
+# -- entry point -------------------------------------------------------
+
+
+def _curses_loop(args) -> int:
+    import curses
+
+    def loop(scr):
+        curses.curs_set(0)
+        scr.nodelay(True)
+        prev = None
+        while True:
+            cur = collect(args.leader, args.standby, args.supervisor)
+            view = build_view(cur, prev)
+            prev = cur
+            scr.erase()
+            maxy, maxx = scr.getmaxyx()
+            for i, ln in enumerate(render(view, width=min(maxx - 1, 100))):
+                if i >= maxy - 1:
+                    break
+                scr.addnstr(i, 0, ln, maxx - 1)
+            scr.refresh()
+            t_end = time.monotonic() + args.interval
+            while time.monotonic() < t_end:
+                ch = scr.getch()
+                if ch in (ord("q"), ord("Q")):
+                    return 0
+                time.sleep(0.05)
+
+    return curses.wrapper(loop) or 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kme-top", description=__doc__)
+    p.add_argument("--leader", default=None, metavar="URL|PATH",
+                   help="leader metrics URL (http://host:port, the "
+                        "/metrics.json path is appended) or heartbeat "
+                        "file (serve.health)")
+    p.add_argument("--standby", default=None, metavar="URL|PATH",
+                   help="standby metrics URL or heartbeat file "
+                        "(standby.health)")
+    p.add_argument("--supervisor", default=None, metavar="PATH",
+                   help="supervisor state mirror "
+                        "(<checkpoint-dir>/supervisor.json)")
+    p.add_argument("--state-root", default=None, metavar="DIR",
+                   help="convenience: a checkpoint dir; fills in "
+                        "--leader/--standby/--supervisor from the "
+                        "conventional file names inside it")
+    p.add_argument("--interval", type=float, default=1.0,
+                   metavar="SECS")
+    p.add_argument("--once", action="store_true",
+                   help="print one plain-text frame and exit (after a "
+                        "second sample --interval later for rates)")
+    p.add_argument("--no-rate-sample", action="store_true",
+                   help="with --once: single sample, no rate")
+    args = p.parse_args(argv)
+    if args.state_root:
+        import os
+
+        args.leader = args.leader or os.path.join(
+            args.state_root, "serve.health")
+        args.standby = args.standby or os.path.join(
+            args.state_root, "standby.health")
+        args.supervisor = args.supervisor or os.path.join(
+            args.state_root, "supervisor.json")
+    if not (args.leader or args.standby or args.supervisor):
+        p.error("nothing to watch: give --leader/--standby/"
+                "--supervisor or --state-root")
+    if args.once:
+        prev = None
+        if not args.no_rate_sample:
+            prev = collect(args.leader, args.standby, args.supervisor)
+            time.sleep(min(args.interval, 1.0))
+        cur = collect(args.leader, args.standby, args.supervisor)
+        for ln in render(build_view(cur, prev)):
+            print(ln)
+        return 0
+    try:
+        return _curses_loop(args)
+    except Exception as e:
+        # no tty / TERM unset (CI): degrade to a plain-text loop
+        print(f"kme-top: curses unavailable ({e}); plain loop "
+              f"(ctrl-c to quit)", file=sys.stderr)
+        prev = None
+        try:
+            while True:
+                cur = collect(args.leader, args.standby,
+                              args.supervisor)
+                for ln in render(build_view(cur, prev)):
+                    print(ln)
+                prev = cur
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,31 @@ class TraceRecorder:
                 ev["args"] = args
             self._events.append(ev)
 
+    def flow(self, name: str, phase: str, flow_id: int,
+             track: str = "main", at_s: float | None = None) -> None:
+        """Chrome trace FLOW event: ph "s" starts arrow `flow_id`, ph
+        "f" finishes it — the renderer draws a causality arrow from the
+        span enclosing the start to the span enclosing the finish
+        (bp="e": bind to the enclosing slice). Links an order batch's
+        submit/engine span to its produce span across tracks."""
+        if phase not in ("s", "f"):
+            raise ValueError(f"flow phase must be 's' or 'f', "
+                             f"got {phase!r}")
+        t = at_s if at_s is not None else time.perf_counter()
+        ev = {
+            "name": name,
+            "ph": phase,
+            "cat": "flow",
+            "id": int(flow_id),
+            "ts": (t - self._t0) * 1e6,
+            "pid": os.getpid(),
+        }
+        if phase == "f":
+            ev["bp"] = "e"
+        with self._lock:
+            ev["tid"] = self._tid(track)
+            self._events.append(ev)
+
     def instant(self, name: str, track: str = "main",
                 args: dict | None = None) -> None:
         ev = {

@@ -354,3 +354,59 @@ def test_seq_checkpoint_roundtrip_telemetry(tmp_path):
     assert off == 300
     assert ses2.metrics() == met
     assert ses2.histograms() == hist
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace flow arrows: the serve pipeline links each batch's engine
+# span to its produce span
+
+
+def test_trace_flow_events():
+    tr = TraceRecorder()
+    tr.flow("batch", "s", 7, track="serve")
+    tr.flow("batch", "f", 7, track="serve")
+    evs = [e for e in tr.trace_events() if e.get("cat") == "flow"]
+    assert [e["ph"] for e in evs] == ["s", "f"]
+    assert all(e["id"] == 7 and e["name"] == "batch" for e in evs)
+    assert "bp" not in evs[0]
+    assert evs[1]["bp"] == "e"          # bind finish to enclosing slice
+    assert evs[1]["ts"] >= evs[0]["ts"]
+    with pytest.raises(ValueError):
+        tr.flow("batch", "x", 1)
+
+
+def test_serve_emits_flow_arrows_per_batch(tmp_path):
+    from kme_tpu.bridge.broker import InProcessBroker
+    from kme_tpu.bridge.provision import provision
+    from kme_tpu.bridge.service import TOPIC_IN, MatchService
+    from kme_tpu.wire import dumps_order
+    from kme_tpu.workload import harness_stream
+
+    tr = TraceRecorder()
+    install(tr)
+    try:
+        br = InProcessBroker()
+        provision(br)
+        msgs = harness_stream(60, seed=2, num_accounts=4,
+                              num_symbols=2, payout_opcode_bug=False,
+                              validate=True)
+        for m in msgs:
+            br.produce(TOPIC_IN, None, dumps_order(m))
+        svc = MatchService(br, engine="oracle", compat="fixed",
+                           batch=16)
+        svc.run(max_messages=len(msgs))
+        svc.close()
+    finally:
+        install(None)
+    evs = tr.trace_events()
+    starts = [e for e in evs
+              if e.get("cat") == "flow" and e["ph"] == "s"]
+    finishes = [e for e in evs
+                if e.get("cat") == "flow" and e["ph"] == "f"]
+    # one arrow per batch, start/finish ids pair up
+    assert starts and len(starts) == len(finishes)
+    assert ([e["id"] for e in starts] ==
+            [e["id"] for e in finishes])
+    # arrows bind to real spans: engine + produce phase slices exist
+    names = {e["name"] for e in evs if e["ph"] == "X"}
+    assert "serve_engine" in names and "serve_produce" in names

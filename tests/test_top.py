@@ -1,0 +1,211 @@
+"""kme-top: source scraping (metrics URL vs heartbeat file), view
+derivation (rates, replica lag), the pure renderer, and a live smoke
+against a running leader + standby pair."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kme_tpu.bridge.broker import InProcessBroker
+from kme_tpu.bridge.provision import provision
+from kme_tpu.bridge.replica import Replica
+from kme_tpu.bridge.service import TOPIC_IN, MatchService
+from kme_tpu.telemetry import start_metrics_server
+from kme_tpu.telemetry.top import (build_view, collect, main, render,
+                                   scrape)
+from kme_tpu.wire import dumps_order
+from kme_tpu.workload import harness_stream
+
+
+# ---------------------------------------------------------------------------
+# scraping
+
+
+def test_scrape_heartbeat_file_vs_registry_snapshot(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    with open(hb, "w") as f:
+        json.dump({"role": "leader", "offset": 7, "degraded": None,
+                   "metrics": {"counters": {"service_records": 7},
+                               "gauges": {}, "latencies": {}}}, f)
+    out = scrape(hb)
+    assert out["ok"] and out["hb"]["offset"] == 7
+    assert out["metrics"]["counters"]["service_records"] == 7
+
+    snap = str(tmp_path / "snap.json")
+    with open(snap, "w") as f:
+        json.dump({"counters": {"service_records": 3}, "gauges": {},
+                   "histograms": {}, "latencies": {}}, f)
+    out = scrape(snap)              # bare registry snapshot, no hb
+    assert out["ok"] and "hb" not in out
+    assert out["metrics"]["counters"]["service_records"] == 3
+
+
+def test_scrape_missing_sources_are_soft():
+    assert scrape(None)["ok"] is False
+    out = scrape("/nonexistent/path.json")
+    assert out["ok"] is False and "error" in out
+    out = scrape("http://127.0.0.1:9/", timeout=0.2)   # closed port
+    assert out["ok"] is False and "error" in out
+    # an unreachable node must not crash the frame
+    view = build_view(collect("/nonexistent", None, None))
+    assert any("unreachable" in ln for ln in render(view))
+
+
+# ---------------------------------------------------------------------------
+# view derivation + rendering (pure)
+
+
+def _node(records=None, gauges=None, lats=None, hb=None):
+    m = {"counters": ({} if records is None
+                      else {"service_records": records}),
+         "gauges": gauges or {}, "latencies": lats or {}}
+    out = {"source": "x", "ok": True, "metrics": m}
+    if hb is not None:
+        out["hb"] = hb
+    return out
+
+
+def test_build_view_rate_and_lag():
+    prev = {"t": 0.0, "leader": _node(records=100),
+            "standby": _node(), "supervisor": None}
+    cur = {"t": 2.0, "leader": _node(records=300),
+           "standby": _node(gauges={"replica_lag_records": 5}),
+           "supervisor": None}
+    view = build_view(cur, prev)
+    assert view["records_per_s"] == pytest.approx(100.0)
+    assert view["replica_lag"] == 5
+    # lag falls back to heartbeat applied/leader_offset
+    cur["standby"] = _node(hb={"applied": 40, "leader_offset": 52})
+    assert build_view(cur, prev)["replica_lag"] == 12
+    # no prev sample -> no rate, never a crash
+    assert build_view(cur)["records_per_s"] is None
+
+
+def test_render_shows_stages_slo_and_supervisor():
+    lats = {"lat_e2e": {"count": 10, "sum_s": 0.1, "p50_ms": 4.0,
+                        "p90_ms": 8.0, "p99_ms": 9.0, "p999_ms": 9.5},
+            "lat_ingress": {"count": 10, "sum_s": 0.01, "p50_ms": 0.5,
+                            "p90_ms": 1.0, "p99_ms": 2.0,
+                            "p999_ms": 2.5}}
+    view = build_view({
+        "t": 1.0,
+        "leader": _node(records=10,
+                        gauges={"slo_ok": 0, "slo_burn_rate": 3.5,
+                                "pipeline_warning": 1},
+                        lats=lats,
+                        hb={"epoch": 2, "offset": 9,
+                            "degraded": "slo burn 3.5x"}),
+        "standby": _node(hb={"applied": 8, "leader_offset": 9,
+                             "out_seq": 4, "discarded": 0}),
+        "supervisor": {"restarts_total": 1, "budget_used": 1,
+                       "max_restarts": 5, "standby_restarts": 0,
+                       "recoveries": [{"t": 1.0, "kind": "leader"}]}})
+    text = "\n".join(render(view))
+    assert "epoch=2" in text and "offset=9" in text
+    assert "DEGRADED: slo burn 3.5x" in text
+    assert "slo=BREACH burn=3.50x" in text
+    assert "pipeline_warning" in text
+    assert "e2e" in text and "ingress" in text and "9.500" in text
+    assert "applied=8" in text and "lag=1" in text
+    assert "restarts=1" in text and "kind=leader" in text
+    # empty view renders too (all sources down)
+    assert render(build_view(collect(None, None, None)))
+
+
+def test_main_requires_a_source():
+    with pytest.raises(SystemExit):
+        main(["--once"])
+
+
+def test_main_state_root_once_over_files(tmp_path, capsys):
+    root = str(tmp_path)
+    with open(os.path.join(root, "serve.health"), "w") as f:
+        json.dump({"role": "leader", "offset": 3, "epoch": 1,
+                   "degraded": None,
+                   "metrics": {"counters": {"service_records": 3},
+                               "gauges": {}, "latencies": {}}}, f)
+    with open(os.path.join(root, "supervisor.json"), "w") as f:
+        json.dump({"restarts_total": 0, "budget_used": 0,
+                   "max_restarts": 5, "standby_restarts": 0,
+                   "recoveries": []}, f)
+    rc = main(["--state-root", root, "--once", "--no-rate-sample"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "offset=3" in out and "restarts=0" in out
+    assert "standby" in out      # missing standby.health shown as down
+
+
+# ---------------------------------------------------------------------------
+# live smoke: leader + standby pair (ISSUE acceptance)
+
+
+def test_top_live_leader_standby_pair(tmp_path, capsys):
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    log_dir = os.path.join(ck, "broker-log")
+    msgs = [dumps_order(m) for m in harness_stream(
+        80, seed=7, num_accounts=4, num_symbols=2,
+        payout_opcode_bug=False, validate=True)]
+
+    br = InProcessBroker(persist_dir=log_dir)
+    provision(br)
+    for m in msgs:
+        br.produce(TOPIC_IN, None, m)
+    leader = MatchService(br, engine="oracle", compat="fixed",
+                          batch=16, slots=64, max_fills=32,
+                          checkpoint_dir=ck, exactly_once=True)
+    leader.run(max_messages=len(msgs))
+    serve_health = os.path.join(ck, "serve.health")
+    leader._write_heartbeat(serve_health, len(msgs))
+    msrv = start_metrics_server(leader.telemetry, 0, host="127.0.0.1")
+    lh, lp = msrv.server_address[:2]
+
+    standby_health = os.path.join(ck, "standby.health")
+    rep = Replica(ck, listen="127.0.0.1:0", engine="oracle", batch=16,
+                  slots=64, max_fills=32, poll=0.02, health_every=0.05,
+                  idle_exit=0.4, health_file=standby_health,
+                  metrics_port=0)
+    rc = [None]
+    t = threading.Thread(target=lambda: rc.__setitem__(0, rep.run()),
+                         daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while (not os.path.exists(standby_health)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert os.path.exists(standby_health), "standby never heartbeat"
+
+        code = main(["--leader", f"http://{lh}:{lp}",
+                     "--standby", standby_health,
+                     "--supervisor", os.path.join(ck,
+                                                  "supervisor.json"),
+                     "--once", "--interval", "0.05"])
+        assert code == 0
+        out = capsys.readouterr().out
+        # leader metrics surface: throughput + the stage table
+        assert f"records={len(msgs):,}" in out
+        assert "e2e" in out and "p99 ms" in out
+        # standby heartbeat surfaced with applied offset + lag
+        assert "standby  applied=" in out
+        assert "unreachable" not in out.split("standby")[1]
+
+        # the standby's own metrics URL also scrapes (replica gauges)
+        sh, sp = rep.metrics_server.server_address[:2]
+        node = scrape(f"http://{sh}:{sp}")
+        assert node["ok"]
+        assert "replica_applied_offset" in node["metrics"]["gauges"]
+    finally:
+        # the follow loop only exits via promotion: issue a pid-less
+        # (manual) promote order, after which idle_exit winds it down
+        leader.close()
+        msrv.shutdown()
+        with open(rep.promote_file, "w") as f:
+            json.dump({"failed_at": time.time()}, f)
+        t.join(timeout=30)
+        if rep.metrics_server is not None:
+            rep.metrics_server.shutdown()
+    assert rc[0] == 0
