@@ -229,57 +229,64 @@ def _bench_seq_latency(symbols: int, accounts: int, seed: int,
                    - min(timed(c1, small_d) for _ in range(2))) / (K - 1)
 
     def run(pipelined: bool):
-        # this loop drives ses._plan/_fetch_outputs/_recon_buffer
-        # directly (not submit/collect), so it records its own
-        # flight-recorder windows for measured_overlap_s
+        # drives the REAL serving surface (SeqSession.submit/collect —
+        # the same calls kme-serve --pipeline makes); the session's
+        # flight-recorder windows feed measured_overlap_s
         ses = SeqSession(cfg)
-        plan_s, recon_s, walls, windows = [], [], [], []
-        pend = []
+        walls, per_batch, pend = [], [], []
 
         def collect_one():
-            nb2, bt2, cols2, hr2, outp2, cnts2, K2, t_sub = pend.pop(0)
-            t_col = time.perf_counter()
-            host2, fills2 = ses._fetch_outputs(outp2, cnts2, K2)
-            t0 = time.perf_counter()
-            ses._recon_buffer(bt2, cols2, hr2, host2, fills2)
-            t1 = time.perf_counter()
-            recon_s.append(t1 - t0)
-            walls.append(t1 - t_sub)
-            windows.append(("collect", nb2, t_col, t1))
+            nb2, t_sub, handle = pend.pop(0)
+            p0 = dict(ses.phases)
+            ses.collect(handle)
+            p1 = ses.phases
+            walls.append(time.perf_counter() - t_sub)
+            per_batch[nb2]["fetch_ms"] = round(
+                (p1.get("fetch_s", 0.0) - p0.get("fetch_s", 0.0)) * 1e3,
+                3)
+            per_batch[nb2]["recon_ms"] = round(
+                (p1.get("recon_s", 0.0) - p0.get("recon_s", 0.0)) * 1e3,
+                3)
 
         t_all = time.perf_counter()
         for nb, bt in enumerate(batches):
             t_sub = time.perf_counter()
-            t0 = time.perf_counter()
-            cols2, hr2, stacked2, cnts2, K2 = ses._plan(bt)
-            plan_s.append(time.perf_counter() - t0)
-            ses.state, outp2 = SQ.build_seq_scan(cfg, K2)(
-                ses.state, stacked2)
-            windows.append(("submit", nb, t_sub, time.perf_counter()))
-            pend.append((nb, bt, cols2, hr2, outp2, cnts2, K2, t_sub))
+            p0 = dict(ses.phases)
+            handle = ses.submit(bt)
+            p1 = ses.phases
+            per_batch.append({
+                "plan_ms": round((p1.get("plan_s", 0.0)
+                                  - p0.get("plan_s", 0.0)) * 1e3, 3),
+                "dispatch_ms": round(
+                    (p1.get("dispatch_s", 0.0)
+                     - p0.get("dispatch_s", 0.0)) * 1e3, 3)})
+            pend.append((nb, t_sub, handle))
             while len(pend) > (1 if pipelined else 0):
                 collect_one()
         while pend:
             collect_one()
-        return (time.perf_counter() - t_all, plan_s, recon_s, walls,
-                windows, ses)
+        return time.perf_counter() - t_all, per_batch, walls, ses
 
     run(True)   # warm every shape (compile shared via lru caches)
-    t_serial, _, _, _, _, _ = run(False)
-    t_pipe, plan_s, recon_s, walls, windows, ses_pipe = run(True)
+    t_serial, _pb0, _w0, _ses0 = run(False)
+    t_pipe, per_batch, walls, ses_pipe = run(True)
 
     from kme_tpu.telemetry.journal import measured_overlap_s
 
+    windows = ses_pipe.windows
     overlap_s = measured_overlap_s(windows)
+    collect_wall = sum(t1 - t0 for kind, _b, t0, t1 in windows
+                      if kind == "collect")
 
-    eng = sorted(p + r + dev_batch_s
-                 for p, r in zip(plan_s, recon_s))
+    eng = sorted((pb["plan_ms"] + pb["recon_ms"]) * 1e-3 + dev_batch_s
+                 for pb in per_batch)
 
     def pct(xs, p):
         import math
 
         return xs[max(0, min(len(xs) - 1, math.ceil(p * len(xs)) - 1))]
 
+    ph = ses_pipe.phases
     res = {
         "batch": batch, "batches": len(batches), "events": len(msgs),
         "engine_side_p50_ms": round(pct(eng, 0.50) * 1e3, 2),
@@ -295,26 +302,38 @@ def _bench_seq_latency(symbols: int, accounts: int, seed: int,
         "pipeline_speedup": round(t_serial / t_pipe, 2),
         # measured from the recorded submit/collect windows: wall time
         # a collect actually ran while another batch was in flight on
-        # device — direct overlap evidence, immune to the run-to-run
-        # tunnel variance that makes the t_serial/t_pipe ratio noisy
-        # (BENCH_r05 reported 0.93 from exactly that variance)
+        # device. The FRACTION is over the total collect wall — the
+        # host-side work the pipeline exists to hide — so it converges
+        # structurally to 1.0 under working double-buffering and is
+        # gateable, unlike the t_serial/t_pipe ratio whose run-to-run
+        # tunnel variance produced the spurious 0.93 in BENCH_r05
         "measured_overlap_s": round(overlap_s, 4),
-        "measured_overlap_frac": round(overlap_s / t_pipe, 4),
-        "method": "double-buffered submit/collect; engine-side = "
-                  "per-batch plan+recon (measured) + device/batch "
-                  "(two-size differencing, averaged); fetch = tunnel. "
-                  "pipeline_speedup ~1 through THIS driver's tunnel "
-                  "(round trips serialize); measured_overlap_s is the "
-                  "window-intersection evidence that the overlap is "
-                  "real even when the wall-clock ratio is noise-bound",
+        "collect_wall_s": round(collect_wall, 4),
+        "measured_overlap_frac": round(
+            overlap_s / max(collect_wall, 1e-9), 4),
+        # cumulative phase walls of the pipelined run (mirrors the
+        # java sub-dict's field names for artifact-diffing)
+        "plan_s": round(ph.get("plan_s", 0.0), 4),
+        "dispatch_s": round(ph.get("dispatch_s", 0.0), 4),
+        "fetch_s": round(ph.get("fetch_s", 0.0), 4),
+        "recon_s": round(ph.get("recon_s", 0.0), 4),
+        "per_batch": per_batch,
+        "method": "double-buffered submit/collect (the serving API); "
+                  "engine-side = per-batch plan+recon (measured) + "
+                  "device/batch (two-size differencing, averaged); "
+                  "fetch = tunnel. pipeline_speedup ~1 through THIS "
+                  "driver's tunnel (round trips serialize); "
+                  "measured_overlap_frac = overlap / collect wall is "
+                  "the gateable overlap evidence",
     }
-    if res["pipeline_speedup"] < 1.0:
+    import jax as _jax
+    res["backend"] = _jax.devices()[0].platform
+    if res["measured_overlap_frac"] < 0.5:
         res["pipeline_warning"] = (
-            f"pipeline_speedup {res['pipeline_speedup']} < 1.0 — "
-            "wall-clock ratio is noise-dominated here; trust "
-            f"measured_overlap_s={res['measured_overlap_s']} "
-            f"({res['measured_overlap_frac']:.1%} of the pipelined "
-            "run was genuinely hidden)")
+            f"measured_overlap_frac {res['measured_overlap_frac']} "
+            "< 0.5 — less than half the collect wall was hidden under "
+            "device execution; the double-buffer is not overlapping "
+            "(host-bound batches or a serializing transport)")
         print(f"kme-bench: WARNING {res['pipeline_warning']}",
               file=sys.stderr)
     publish_pipeline_gauges(ses_pipe.telemetry, res)
@@ -328,13 +347,175 @@ def publish_pipeline_gauges(registry, detail: dict) -> None:
     with the prose staying in the detail dict."""
     g = registry.gauge
     for k in ("pipeline_speedup", "device_ms_per_batch",
-              "measured_overlap_frac"):
+              "measured_overlap_frac", "local_s"):
         if k in detail:
             g(k).set(detail[k])
     g("pipeline_warning",
-      "1 when pipeline_speedup fell under 1.0 (wall-clock ratio "
-      "noise-dominated; see measured_overlap_s)").set(
+      "1 when measured_overlap_frac fell under 0.5 (the collect wall "
+      "is not being hidden under device execution)").set(
         1 if detail.get("pipeline_warning") else 0)
+
+
+def bench_pipeline(events: int = 40_960, symbols: int = 32,
+                   accounts: int = 256, seed: int = 0,
+                   zipf_a: float = 1.2, batch: int = 1024,
+                   depth: int = 2) -> dict:
+    """IN-PROCESS pipelined serving bench (no TCP, no broker): the
+    serve hot path — bytes parse -> native plan+pack -> async dispatch
+    under the previous batch's device step -> fetch -> native
+    reconstruction — driven through SeqSession.submit/collect exactly
+    as `kme-serve --pipeline` drives it, against the serial
+    submit+collect-immediately loop over the SAME byte stream.
+
+    Because no transport round trips serialize the loop, this is the
+    suite where the double-buffer's wall-clock win is actually
+    measurable (pipeline_speedup > 1) and where the host-path gate
+    metrics are recorded: `local_s` (parse + plan + recon — the wall
+    the host spends OFF the device) and `measured_overlap_frac`
+    (fraction of the collect wall hidden under device execution).
+    Output parity between the two runs is asserted byte-for-byte."""
+    import time
+
+    import jax
+
+    from kme_tpu.engine import seq as SQ
+    from kme_tpu.native import load_library
+    from kme_tpu.runtime.seqsession import SeqSession
+    from kme_tpu.wire import WireBatch, dumps_order
+    from kme_tpu.workload import zipf_symbol_stream
+
+    if load_library() is None:
+        raise RuntimeError(
+            "the pipeline suite needs the native host runtime "
+            "(KME_NATIVE=0 or no toolchain?) — the buffer serving "
+            "path under test is native-only")
+    msgs = zipf_symbol_stream(events, num_symbols=symbols,
+                              num_accounts=accounts, seed=seed,
+                              zipf_a=zipf_a)
+    slots = 128
+    accounts_eff = -(-accounts // 128) * 128
+    cfg = SQ.SeqConfig(lanes=symbols, slots=slots,
+                       accounts=accounts_eff, max_fills=16,
+                       batch=max(128, min(4096,
+                                          1 << (batch - 1).bit_length())))
+    # the serve loop's input: newline-framed wire bytes per batch
+    bufs = []
+    for lo in range(0, len(msgs), batch):
+        bufs.append("\n".join(dumps_order(m)
+                              for m in msgs[lo:lo + batch]).encode())
+
+    def run(pipelined: bool):
+        ses = SeqSession(cfg)
+        parse_s = 0.0
+        pend, outs, per_batch = [], [], []
+
+        def collect_one():
+            nb2, handle = pend.pop(0)
+            p0 = dict(ses.phases)
+            buf, _lo, _ml = ses.collect(handle)
+            p1 = ses.phases
+            outs.append(buf)
+            per_batch[nb2]["fetch_ms"] = round(
+                (p1.get("fetch_s", 0.0) - p0.get("fetch_s", 0.0)) * 1e3,
+                3)
+            per_batch[nb2]["recon_ms"] = round(
+                (p1.get("recon_s", 0.0) - p0.get("recon_s", 0.0)) * 1e3,
+                3)
+
+        t_all = time.perf_counter()
+        for nb, raw in enumerate(bufs):
+            t0 = time.perf_counter()
+            wb = WireBatch.parse_buffer(raw)
+            tp = time.perf_counter() - t0
+            parse_s += tp
+            p0 = dict(ses.phases)
+            handle = ses.submit(wb)
+            p1 = ses.phases
+            per_batch.append({
+                "parse_ms": round(tp * 1e3, 3),
+                "plan_ms": round((p1.get("plan_s", 0.0)
+                                  - p0.get("plan_s", 0.0)) * 1e3, 3),
+                "dispatch_ms": round(
+                    (p1.get("dispatch_s", 0.0)
+                     - p0.get("dispatch_s", 0.0)) * 1e3, 3)})
+            pend.append((nb, handle))
+            while len(pend) > (depth if pipelined else 0):
+                collect_one()
+        while pend:
+            collect_one()
+        return (time.perf_counter() - t_all, parse_s, per_batch,
+                b"".join(outs), ses)
+
+    run(True)   # warm every shape bucket (jit caches shared)
+    # best-of-two per mode: the hideable host wall is a few percent of
+    # the CPU device wall, so a single-run ratio flaps on scheduler
+    # noise; the systematic win survives a min-of-2
+    s_runs = [run(False) for _ in range(2)]
+    t_serial = min(r[0] for r in s_runs)
+    out_serial = s_runs[0][3]
+    p_runs = [run(True) for _ in range(2)]
+    t_pipe, parse_s, per_batch, out_pipe, ses = min(
+        p_runs, key=lambda r: r[0])
+    assert out_pipe == out_serial, (
+        f"pipelined output diverged from serial "
+        f"({len(out_pipe)} vs {len(out_serial)} bytes)")
+
+    from kme_tpu.telemetry.journal import measured_overlap_s
+
+    windows = ses.windows
+    overlap_s = measured_overlap_s(windows)
+    collect_wall = sum(t1 - t0 for kind, _b, t0, t1 in windows
+                       if kind == "collect")
+    ph = ses.phases
+    n = len(msgs)
+    local_s = (parse_s + ph.get("plan_s", 0.0) + ph.get("recon_s", 0.0))
+    ops = n / t_pipe
+    detail = {
+        "engine": "seq (submit/collect, in-process)",
+        "events": n, "symbols": symbols, "accounts": accounts_eff,
+        "batch": batch, "depth": depth, "batches": len(bufs),
+        "serial_wall_s": round(t_serial, 4),
+        "pipelined_wall_s": round(t_pipe, 4),
+        "pipelined_orders_per_sec": round(ops, 1),
+        "serial_orders_per_sec": round(n / t_serial, 1),
+        "pipeline_speedup": round(t_serial / t_pipe, 4),
+        "measured_overlap_s": round(overlap_s, 4),
+        "collect_wall_s": round(collect_wall, 4),
+        "measured_overlap_frac": round(
+            overlap_s / max(collect_wall, 1e-9), 4),
+        # the host-path wall the native layer exists to shrink:
+        # bytes->columns parse + route/pack plan + output recon
+        "local_s": round(local_s, 4),
+        "local_orders_per_sec": round(n / max(local_s, 1e-9), 1),
+        "parse_s": round(parse_s, 4),
+        "plan_s": round(ph.get("plan_s", 0.0), 4),
+        "dispatch_s": round(ph.get("dispatch_s", 0.0), 4),
+        "fetch_s": round(ph.get("fetch_s", 0.0), 4),
+        "recon_s": round(ph.get("recon_s", 0.0), 4),
+        "per_batch": per_batch,
+        "out_mb": round(len(out_pipe) / 1e6, 2),
+        "parity": "pipelined byte stream == serial byte stream",
+        "backend": jax.devices()[0].platform,
+        "method": "same byte stream through submit/collect twice: "
+                  "serial (collect immediately) vs depth-N pipelined "
+                  "(parse+plan+dispatch of batch N+1 under batch N's "
+                  "device step); no transport in the loop",
+    }
+    if detail["measured_overlap_frac"] < 0.5:
+        detail["pipeline_warning"] = (
+            f"measured_overlap_frac {detail['measured_overlap_frac']} "
+            "< 0.5 — less than half the collect wall was hidden under "
+            "device execution")
+        print(f"kme-bench: WARNING {detail['pipeline_warning']}",
+              file=sys.stderr)
+    publish_pipeline_gauges(ses.telemetry, detail)
+    return {
+        "metric": "pipelined_orders_per_sec",
+        "value": round(ops, 1),
+        "unit": "orders/s",
+        "vs_baseline": round(ops / REFERENCE_BASELINE_OPS, 3),
+        "detail": detail,
+    }
 
 
 def bench_seq_engine(events: int = 100_000, symbols: int = 1024,
@@ -545,7 +726,7 @@ def bench_seq_engine(events: int = 100_000, symbols: int = 1024,
                        "audit_overhead_frac": round(audit_s / total, 4),
                        "audit_violations": len(aud.violations)})
         detail["journal"] = jd
-    if compat == "fixed" and n >= 50_000 \
+    if compat == "fixed" and n >= 50_000 and native_ok \
             and os.environ.get("KME_BENCH_LATENCY", "1") != "0":
         # the streaming-latency row (VERDICT r4 #6): engine-side
         # per-batch latency + double-buffered serving overlap, in the
@@ -884,8 +1065,11 @@ def main(argv=None) -> int:
 
     p = argparse.ArgumentParser(prog="kme-bench")
     p.add_argument("--suite", choices=("lanes", "parity", "native",
-                                       "latency"),
+                                       "latency", "pipeline"),
                    default="lanes")
+    p.add_argument("--pipeline", type=int, default=2, metavar="N",
+                   help="pipeline suite: in-flight batch window depth "
+                        "(how many submits may run ahead of collect)")
     p.add_argument("--engine", choices=("seq", "sweep"), default="seq",
                    help="lanes-suite engine: the sequential mega-kernel "
                         "(default) or the vectorized sweep engine")
@@ -997,6 +1181,10 @@ def main(argv=None) -> int:
         rec = bench_native_engine(args.events or 100_000, args.seed,
                                   max(args.batch, 1),
                                   args.compat or "java")
+    elif args.suite == "pipeline":
+        rec = bench_pipeline(args.events or 40_960, args.symbols,
+                             args.accounts, args.seed, args.zipf,
+                             batch=args.batch, depth=args.pipeline)
     elif args.suite == "latency":
         rec = bench_latency(args.events or 20_000, args.symbols,
                             args.accounts, args.seed, args.zipf,

@@ -144,6 +144,14 @@ def main(argv=None) -> int:
                         "(a quiet service is not a degraded one)")
     p.add_argument("--slo-min-records-per-sec", type=float, default=0.0,
                    metavar="R", help="optional throughput floor")
+    p.add_argument("--pipeline", type=int, default=0, metavar="N",
+                   help="double-buffered serving: keep up to N batches "
+                        "in flight — batch N+1's parse/plan/dispatch "
+                        "runs under batch N's device step; offsets and "
+                        "checkpoints still advance only once a batch's "
+                        "outputs are visible (needs engine=seq, "
+                        "compat=fixed and the native host runtime; "
+                        "anything else serves serial with a note)")
     p.add_argument("--annotate-rejects", action="store_true",
                    help="emit an ADDITIVE 'REJ'-keyed MatchOut record "
                         "naming each rejected order's rej_* reason "
@@ -213,6 +221,7 @@ def main(argv=None) -> int:
                        audit_repro_dir=args.audit_repro_dir,
                        annotate_rejects=args.annotate_rejects,
                        exactly_once=exactly_once,
+                       pipeline=args.pipeline,
                        slo=(None if args.slo_p99_ms is None else {
                            "stage": args.slo_stage,
                            "p99_ms": args.slo_p99_ms,

@@ -65,6 +65,7 @@ class MatchService:
                  annotate_rejects: bool = False,
                  exactly_once: bool = False,
                  follower: bool = False,
+                 pipeline: int = 0,
                  slo=None) -> None:
         if engine not in ("lanes", "seq", "oracle", "native"):
             raise ValueError(f"unknown engine {engine!r}")
@@ -100,6 +101,30 @@ class MatchService:
         self.annotate_rejects = annotate_rejects
         self.exactly_once = exactly_once
         self.follower = follower
+        # double-buffered serving (SURVEY.md §7 H5): up to `pipeline`
+        # batches stay in flight — batch N+1's parse/plan/dispatch runs
+        # under batch N's device step; offsets/checkpoints advance only
+        # at collect time, so the durability contract is unchanged.
+        # Needs the seq engine (submit/collect), fixed mode and the
+        # native host runtime (buffer reconstruction); anything else
+        # serves serial with a note.
+        self.pipeline = 0
+        self._pipe = None
+        if pipeline:
+            from kme_tpu.native import load_library
+
+            if (engine == "seq" and compat == "fixed"
+                    and not annotate_rejects
+                    and load_library() is not None):
+                import collections
+
+                self.pipeline = int(pipeline)
+                self._pipe = collections.deque()
+            else:
+                print("kme-serve: --pipeline needs engine=seq, "
+                      "compat=fixed, the native host runtime and no "
+                      "--annotate-rejects; serving serial",
+                      file=sys.stderr)
         self.epoch: Optional[int] = None  # leader fencing token
         self.out_seq = 0                  # next MatchOut produce stamp
         if exactly_once and checkpoint_dir is None:
@@ -294,6 +319,8 @@ class MatchService:
 
     def close(self) -> None:
         """Flush + close the flight recorder (serve shutdown path)."""
+        if getattr(self, "_pipe", None):
+            self._drain_pipeline()
         if getattr(self, "journal", None) is not None:
             self.journal.close()
 
@@ -499,6 +526,10 @@ class MatchService:
         """Snapshot engine state + input offset (batch boundary)."""
         from kme_tpu.runtime import checkpoint as ck
 
+        if getattr(self, "_pipe", None):
+            # a snapshot must capture engine state at a committed
+            # offset boundary — collect every in-flight batch first
+            self._drain_pipeline()
         # make the input log durable BEFORE committing an offset into it:
         # the snapshot is fsync'd, so without this a power loss could
         # leave an offset addressing MatchIn records the OS never wrote
@@ -594,6 +625,8 @@ class MatchService:
     def step(self, timeout: float = 0.5) -> int:
         """Poll once: fetch up to `batch` records, process, produce the
         record stream. Returns the number of input records consumed."""
+        if self._pipe is not None and self._session is not None:
+            return self._step_pipelined(timeout)
         from kme_tpu.bridge.broker import BrokerError
 
         try:
@@ -608,6 +641,13 @@ class MatchService:
             return 0
         if not recs:
             return 0
+        return self._process_batch(recs)
+
+    def _process_batch(self, recs) -> int:
+        """Serial batch processing: parse, engine, produce, commit —
+        the per-record authority every engine/compat combination
+        supports (the pipelined path above delegates here for batches
+        with malformed or out-of-envelope records)."""
         import time as _t
 
         fetch_us = _t.time_ns() // 1000
@@ -737,6 +777,197 @@ class MatchService:
         self._publish_batch(len(recs), len(recs) - len(msgs))
         return len(recs)
 
+    # -- pipelined serving (H5): submit N+1 while N runs on the device
+
+    def _parse_batch(self, recs):
+        """Columnar parse of a fetched batch (native kme_parse when
+        built). Returns a WireBatch when EVERY record parses clean and
+        passes the reference's int32 price/size envelope — the hot
+        case; None sends the batch through the per-record _parse path
+        (whose drop/strict policy is the authority for bad input)."""
+        import numpy as np
+
+        from kme_tpu.wire import WireBatch
+
+        try:
+            payload = b"\n".join(
+                v if isinstance(v, bytes) else v.encode()
+                for v in (r.value for r in recs))
+            wb = WireBatch.parse_buffer(payload)
+        except (ValueError, OverflowError, UnicodeEncodeError,
+                AttributeError):
+            return None
+        if wb.n != len(recs):
+            return None  # embedded newlines / empty values
+        lim = 1 << 31
+        if not (np.all(wb.price >= -lim) and np.all(wb.price < lim)
+                and np.all(wb.size >= -lim) and np.all(wb.size < lim)):
+            return None
+        return wb
+
+    def _step_pipelined(self, timeout: float = 0.5) -> int:
+        """Poll once in pipelined mode: parse + plan + DISPATCH this
+        batch without waiting on the device, then retire the oldest
+        in-flight batch once the window exceeds `pipeline` — batch
+        N+1's host work runs under batch N's device step. The fetch
+        cursor runs ahead of the committed offset by the in-flight
+        window; self.offset still advances only at collect time, so
+        the at-least-once replay contract (H5 batch-boundary commit)
+        is unchanged."""
+        from kme_tpu.bridge.broker import BrokerError
+
+        fetch_off = self._pipe[-1][0] if self._pipe else self.offset
+        try:
+            recs = self.broker.fetch(TOPIC_IN, fetch_off, self.batch,
+                                     timeout=timeout)
+        except BrokerError:
+            import time
+
+            time.sleep(min(timeout, 0.05))
+            return 0
+        if not recs:
+            # idle input: finish the in-flight window so output
+            # visibility and offsets never stall behind an empty poll
+            self._drain_pipeline()
+            return 0
+        import time as _t
+
+        wb = self._parse_batch(recs)
+        if wb is None:
+            # malformed / out-of-envelope records: drain, then run the
+            # batch through the exact per-record path (drops, strict)
+            self._drain_pipeline()
+            return self._process_batch(recs)
+        fetch_us = _t.time_ns() // 1000
+        lat = self._lat
+        atss = []
+        for r in recs:
+            ats = getattr(r, "ats", None)
+            atss.append(ats)
+            if ats is not None:
+                lat["ingress"].observe(max(0, fetch_us - ats) * 1e-6)
+        end_off = recs[-1].offset + 1
+        if (self.checkpoint_dir is not None and not self.follower
+                and self._pipe
+                and end_off - self._last_ckpt_offset
+                >= self.checkpoint_every):
+            # a due snapshot needs a drained pipeline (engine state at
+            # a committed offset boundary); drain BEFORE submitting so
+            # the cadenced checkpoint fires at this batch's collect
+            self._drain_pipeline()
+        self._batch_ordinal += 1
+        phases = self._session.phases
+        p0 = dict(phases)
+        with self._ptimer.phase("serve_engine"):
+            self._flow("s")
+            handle = self._session.submit(wb)
+        plan_d = phases.get("plan_s", 0.0) - p0.get("plan_s", 0.0)
+        self._pipe.append((end_off, handle, wb,
+                           [r.offset for r in recs], atss, fetch_us,
+                           plan_d, self._batch_ordinal))
+        while len(self._pipe) > self.pipeline:
+            self._collect_one()
+        return len(recs)
+
+    def _collect_one(self) -> None:
+        """Retire the oldest in-flight batch: fetch + reconstruct its
+        outputs, produce, journal, and only THEN advance the committed
+        offset. Checkpoints wait for an empty pipeline: a snapshot must
+        pair engine state with an offset whose every predecessor is
+        visible on MatchOut."""
+        import time as _t
+
+        (end_off, handle, wb, offs, atss, fetch_us, plan_d,
+         ordinal) = self._pipe.popleft()
+        lat = self._lat
+        self._last_produce_s = 0.0
+        phases = self._session.phases
+        p0 = dict(phases)
+        with self._ptimer.phase("serve_engine"):
+            buf, line_off, msg_lines = self._session.collect(handle)
+        reasons = self._session.last_reasons
+        # device attribution under pipelining: what the batch WAITED at
+        # fetch time (overlapped device work the host never sees is the
+        # point of the pipeline)
+        dev_d = phases.get("fetch_s", 0.0) - p0.get("fetch_s", 0.0)
+        self._produce_buffer(buf, line_off, ordinal)
+        done_us = _t.time_ns() // 1000
+        n = wb.n
+        if plan_d > 0:
+            lat["plan"].observe(plan_d, n)
+        if dev_d > 0:
+            lat["device"].observe(dev_d, n)
+            self.telemetry.gauge(
+                "device_ms_per_batch",
+                "device wall time of the last batch").set(
+                round(dev_d * 1e3, 3))
+        if self._last_produce_s > 0:
+            lat["produce"].observe(self._last_produce_s, n)
+        for ats in atss:
+            if ats is not None:
+                lat["e2e"].observe(max(0, done_us - ats) * 1e-6)
+        if self.journal is not None and n:
+            out = self._lines_of(buf, line_off, msg_lines)
+            self.journal.record_batch(out, reasons=reasons,
+                                      offsets=offs, drops=[])
+            plan_us = int(plan_d * 1e6)
+            dev_us = int(dev_d * 1e6)
+            prod_us = int(self._last_produce_s * 1e6)
+            oids = wb.oid.tolist()
+            self.journal.record_latency(
+                [{"off": offs[i], "oid": int(oids[i]),
+                  "in_us": (max(0, fetch_us - atss[i])
+                            if atss[i] is not None else 0),
+                  "plan_us": plan_us, "dev_us": dev_us,
+                  "prod_us": prod_us,
+                  "e2e_us": (max(0, done_us - atss[i])
+                             if atss[i] is not None else 0)}
+                 for i in range(n)], batch=ordinal)
+        self.offset = end_off
+        if not self.follower:
+            faults.kill_now("serve.kill", offset=self.offset)
+        if not self._pipe:
+            # engine state now equals the committed offset — the only
+            # point where a snapshot is coherent under pipelining
+            self._maybe_checkpoint()
+        self._commit_watermark()
+        self._publish_batch(n, 0)
+
+    def _drain_pipeline(self) -> None:
+        """Collect every in-flight batch (idle input, a slow-path
+        batch, a due checkpoint, shutdown)."""
+        while self._pipe:
+            self._collect_one()
+
+    @staticmethod
+    def _lines_of(buf, line_off, msg_lines):
+        """Reconstruction buffer -> per-message line lists (the journal
+        and annotation surfaces still speak lines)."""
+        text = buf.decode("ascii")
+        lo = line_off.tolist()
+        out, li = [], 0
+        for nl in msg_lines.tolist():
+            out.append([text[lo[li + k]:lo[li + k + 1]]
+                        for k in range(nl)])
+            li += nl
+        return out
+
+    def _produce_buffer(self, buf, line_off, ordinal=None) -> None:
+        """Produce a reconstructed record buffer line by line — the
+        collect-side twin of _produce_lines (same stamping, retry and
+        flow-arrow semantics)."""
+        import time as _t
+
+        t0 = _t.perf_counter()
+        with self._ptimer.phase("serve_produce"):
+            self._flow("f", ordinal)
+            text = buf.decode("ascii")
+            lo = line_off.tolist()
+            for i in range(len(lo) - 1):
+                key, _, value = text[lo[i]:lo[i + 1]].partition(" ")
+                self._produce_retry(TOPIC_OUT, key, value, stamp=True)
+        self._last_produce_s += _t.perf_counter() - t0
+
     def _publish_batch(self, nrecs: int, ndropped: int) -> None:
         """Per-batch service counters + a rate-limited engine refresh.
         Runs on the POLL THREAD only: the engine refresh touches device
@@ -762,6 +993,25 @@ class MatchService:
             t.gauge("journal_lag_bytes",
                     "bytes accepted by the journal but not yet "
                     "committed by its writer").set(self.journal.lag_bytes)
+        ph = getattr(self._session, "phases", None) \
+            if self._session is not None else None
+        if ph:
+            # host-path attribution (ISSUE: live gauges): cumulative
+            # wall seconds the serve loop spent OFF the device
+            plan = ph.get("plan_s", 0.0)
+            recon = ph.get("recon_s", 0.0)
+            t.gauge("plan_s",
+                    "cumulative host planning wall (s)").set(
+                round(plan, 6))
+            t.gauge("recon_s",
+                    "cumulative output reconstruction wall (s)").set(
+                round(recon, 6))
+            t.gauge("host_path_s",
+                    "cumulative host-path wall: plan + "
+                    "reconstruction (s)").set(round(plan + recon, 6))
+        if self._pipe is not None:
+            t.gauge("pipeline_depth",
+                    "in-flight pipelined batches").set(len(self._pipe))
         now = time.monotonic()
         if now - self._last_engine_pub >= 1.0:
             self._last_engine_pub = now
@@ -829,15 +1079,19 @@ class MatchService:
                 time.sleep(delay)
                 delay = min(delay * 2, 1.0)
 
-    def _flow(self, phase: str) -> None:
+    def _flow(self, phase: str, ordinal: Optional[int] = None) -> None:
         """Trace flow arrow endpoint for the current batch: "s" inside
         the engine span, "f" inside the produce span — Perfetto draws
-        the causality arrow submit -> produce across tracks."""
+        the causality arrow submit -> produce across tracks. Pipelined
+        collects pass their submit-time ordinal explicitly (newer
+        batches may have submitted in between)."""
         from kme_tpu.telemetry import get_tracer
 
         tr = get_tracer()
         if tr is not None:
-            tr.flow("batch", phase, self._batch_ordinal, track="serve")
+            tr.flow("batch", phase,
+                    self._batch_ordinal if ordinal is None else ordinal,
+                    track="serve")
 
     def _produce_lines(self, out) -> None:
         import time as _t
@@ -995,10 +1249,16 @@ class MatchService:
                     while True:
                         time.sleep(0.5)
         finally:
-            if beat_stop is not None:
-                beat_stop.set()
-                self._write_heartbeat(health_file, seen, tick_box[0],
-                                      closing=True)
+            try:
+                if self._pipe:
+                    # in-flight batches hold committed-but-invisible
+                    # work — finish them before the final heartbeat
+                    self._drain_pipeline()
+            finally:
+                if beat_stop is not None:
+                    beat_stop.set()
+                    self._write_heartbeat(health_file, seen,
+                                          tick_box[0], closing=True)
         return seen
 
     def _write_heartbeat(self, path: str, seen: int,

@@ -190,6 +190,22 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                               c.POINTER(c.c_uint8), P64]
                            + [c.c_int64] + [P64] * 4 + [c.c_void_p],
                            c.c_int32),
+        # native batch plan + H2D pack (kme_host.cpp kme_pack_*)
+        "kme_pack_new": ([], c.c_void_p),
+        "kme_pack_free": ([c.c_void_p], None),
+        "kme_plan_batch": ([c.c_void_p, c.c_void_p, c.c_int64]
+                           + [P64] * 6 + [c.c_int32], c.c_int64),
+        "kme_pack_planes": ([c.c_void_p], P32),
+        "kme_pack_err_index": ([c.c_void_p], c.c_int64),
+        # native one-pass batch reconstruction (kme_wire.cpp)
+        "kme_recon_batch": ([c.c_int64] + [P64] * 6
+                            + [P64, c.POINTER(c.c_uint8)] * 2
+                            + [c.c_int64, P64, P32, P32]
+                            + [c.POINTER(c.c_uint8), P64, P64, P64,
+                               c.POINTER(c.c_uint8)]
+                            + [c.c_int64, P64, c.c_int64, P64]
+                            + [c.c_int64] + [P64] * 4 + [c.c_void_p],
+                            c.c_int32),
         # native wire parsing (kme_wire.cpp kme_parse_*)
         "kme_parse_new": ([], c.c_void_p),
         "kme_parse_free": ([c.c_void_p], None),

@@ -179,6 +179,107 @@ int32_t kme_recon_wire(
   return 0;
 }
 
+// One-pass reconstruction straight from the engine's routed/host arrays
+// (the D2H half of the native host path). kme_recon_wire needs ~10
+// per-message scatter arrays built in numpy first; this entry absorbs
+// that: routed rows arrive in ascending msg-index order (the router
+// emits at most one row per message, in order), so a single merge walk
+// recovers isdev/act/ok/fill-window per message, translates lane -> sid
+// and fill account-index -> aid through the two LUTs, and emits through
+// the same line builders. Fill windows are the running sum of h_nfill
+// over ALL routed rows (failed rows carry nfill 0), matching the numpy
+// cumsum. Returns 0 on success, 1 on an out-of-range lane / account
+// index / fill offset (the Python caller raises; numpy would IndexError
+// on the same input).
+int32_t kme_recon_batch(
+    int64_t nmsg, const int64_t* m_action, const int64_t* m_oid,
+    const int64_t* m_aid, const int64_t* m_sid, const int64_t* m_price,
+    const int64_t* m_size, const int64_t* m_next, const uint8_t* m_has_next,
+    const int64_t* m_prev, const uint8_t* m_has_prev,
+    int64_t nr, const int64_t* r_msg, const int32_t* r_act,
+    const int32_t* r_lane,
+    const uint8_t* h_ok, const int64_t* h_nfill, const int64_t* h_resid,
+    const int64_t* h_prev, const uint8_t* h_append,
+    int64_t nlanes, const int64_t* lane_sid,
+    int64_t nacct, const int64_t* idx2aid,
+    int64_t nfills, const int64_t* f_oid, const int64_t* f_aidx,
+    const int64_t* f_price, const int64_t* f_size, void* handle) {
+  Recon& r = *static_cast<Recon*>(handle);
+  int64_t lines = 2 * nmsg + 2 * nfills;
+  int64_t need = 240 * lines + 64;
+  if (r.cap < need) {
+    delete[] r.buf;
+    r.buf = new char[need];
+    r.cap = need;
+  }
+  if (r.lines_cap < lines) {
+    delete[] r.line_off;
+    r.line_off = new int64_t[lines];
+    r.lines_cap = lines;
+  }
+  if (r.nmsg_cap < nmsg) {
+    delete[] r.msg_lines;
+    r.msg_lines = new int32_t[nmsg];
+    r.nmsg_cap = nmsg;
+  }
+  r.len = 0;
+  r.n_lines = 0;
+
+  int64_t k = 0;   // routed-row cursor
+  int64_t o0 = 0;  // running fill offset
+  for (int64_t i = 0; i < nmsg; i++) {
+    int64_t lines0 = r.n_lines;
+    start_line(r, "IN ", 3);
+    put_order(r, m_action[i], m_oid[i], m_aid[i], m_sid[i], m_price[i],
+              m_size[i], m_has_next[i], m_next[i], m_has_prev[i],
+              m_prev[i]);
+    bool isdev = k < nr && r_msg[k] == i;
+    bool ok = isdev && h_ok[k] != 0;
+    if (!ok) {
+      start_line(r, "OUT ", 4);
+      put_order(r, OP_REJECT, m_oid[i], m_aid[i], m_sid[i], m_price[i],
+                m_size[i], m_has_next[i], m_next[i], m_has_prev[i],
+                m_prev[i]);
+    } else {
+      int32_t act = r_act[k];
+      if (act == L_BUY || act == L_SELL) {
+        if (r_lane[k] < 0 || r_lane[k] >= nlanes) return 1;
+        int64_t sid = lane_sid[r_lane[k]];
+        int64_t mk = act == L_BUY ? OP_SOLD : OP_BOUGHT;
+        int64_t tk = act == L_BUY ? OP_BOUGHT : OP_SOLD;
+        for (int64_t e = 0; e < h_nfill[k]; e++) {
+          if (o0 + e >= nfills) return 1;
+          int64_t ai = f_aidx[o0 + e];
+          if (ai < 0 || ai >= nacct) return 1;
+          start_line(r, "OUT ", 4);
+          put_order(r, mk, f_oid[o0 + e], idx2aid[ai], sid, 0,
+                    f_size[o0 + e], false, 0, false, 0);
+          start_line(r, "OUT ", 4);
+          put_order(r, tk, m_oid[i], m_aid[i], sid,
+                    m_price[i] - f_price[o0 + e], f_size[o0 + e],
+                    false, 0, false, 0);
+        }
+        start_line(r, "OUT ", 4);
+        bool app = h_append[k] != 0;
+        put_order(r, m_action[i], m_oid[i], m_aid[i], m_sid[i],
+                  m_price[i], h_resid[k], m_has_next[i], m_next[i],
+                  app || m_has_prev[i], app ? h_prev[k] : m_prev[i]);
+      } else {
+        start_line(r, "OUT ", 4);
+        put_order(r, m_action[i], m_oid[i], m_aid[i], m_sid[i],
+                  m_price[i], m_size[i], m_has_next[i], m_next[i],
+                  m_has_prev[i], m_prev[i]);
+      }
+    }
+    if (isdev) {
+      o0 += h_nfill[k];
+      k++;
+    }
+    r.msg_lines[i] = static_cast<int32_t>(r.n_lines - lines0);
+  }
+  return 0;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------

@@ -206,3 +206,125 @@ class NativeScheduler:
 
     def sid_of_lane(self) -> Dict[int, int]:
         return {lane: sid for sid, lane in self.sid_lane.items()}
+
+
+# -- batch host-path entry points (one C++ call per stage) ----------------
+#
+# The serve/bench hot loop's host work — envelope check + route + H2D
+# staging pack on the way in, output-array -> byte-stream reconstruction
+# on the way out — as single C calls (kme_plan_batch / kme_recon_batch).
+# Both return None when the loaded library predates the entry points so
+# callers fall back to the numpy implementations, which remain the
+# semantics authority (parity pinned by tests/test_host_path.py).
+
+
+def plan_batch(router, batch, B: int):
+    """Envelope-check + route + pack one WireBatch into the stacked
+    (K, B) i32 scan-input planes in a single native call. `router` must
+    be a NativeSeqRouter (the caller checks); returns
+    (cols, host_rejects, stacked, cnts, K) with SeqSession._plan's
+    exact contract, or None when unavailable. The stacked planes are
+    zero-copy views into a rotating native buffer (4 deep): each is
+    consumed by the very next jit dispatch, and double-buffered serving
+    keeps at most two packed batches in flight."""
+    lib = router._lib
+    if not hasattr(lib, "kme_plan_batch"):
+        return None
+    pack = getattr(router, "_pack", None)
+    if pack is None:
+        import weakref
+
+        pack = lib.kme_pack_new()
+        router._pack = pack
+        router._pack_fin = weakref.finalize(router, lib.kme_pack_free,
+                                            pack)
+    raw = {f: np.ascontiguousarray(getattr(batch, f))
+           for f in ("action", "oid", "aid", "sid", "price", "size")}
+    P64 = ctypes.POINTER(ctypes.c_int64)
+    K = int(lib.kme_plan_batch(
+        pack, router._h, batch.n,
+        *(raw[f].ctypes.data_as(P64)
+          for f in ("action", "oid", "aid", "sid", "price", "size")),
+        B))
+    if K == -3:
+        i = int(lib.kme_pack_err_index(pack))
+        raise EnvelopeError(
+            f"message {i}: price/size outside int32 "
+            f"(price={int(raw['price'][i])}, "
+            f"size={int(raw['size'][i])})")
+    if K < 0:
+        raise CapacityError(
+            f"{'account' if K == -1 else 'symbol'} capacity "
+            f"exhausted (id={lib.kme_router_err_value(router._h)})")
+    h = router._h
+    nr = int(lib.kme_router_n_routed(h))
+    nj = int(lib.kme_router_n_rejects(h))
+    cols = {
+        "msg_index": _arr(lib.kme_router_o_msg(h), nr, np.int64),
+        "act": _arr(lib.kme_router_o_act(h), nr, np.int32),
+        "aid": _arr(lib.kme_router_o_aidx(h), nr, np.int32),
+        "price": _arr(lib.kme_router_o_price(h), nr, np.int32),
+        "size": _arr(lib.kme_router_o_size(h), nr, np.int32),
+        "lane": _arr(lib.kme_router_o_lane(h), nr, np.int32),
+        "oid": _arr(lib.kme_router_o_oid(h), nr, np.int64),
+    }
+    host_rejects = set(_arr(lib.kme_router_o_rej(h), nj,
+                            np.int64).tolist())
+    planes = np.ctypeslib.as_array(lib.kme_pack_planes(pack),
+                                   shape=(7, K, B))
+    stacked = {name: planes[j] for j, name in enumerate(
+        ("act", "aid", "price", "size", "lane", "oid_lo", "oid_hi"))}
+    cnts = [max(min(B, nr - ci * B), 0) for ci in range(K)]
+    return cols, host_rejects, stacked, cnts, K
+
+
+def recon_batch(lib, handle, batch, cols, host, fills, lane_sid,
+                idx2aid):
+    """One-pass native reconstruction (kme_recon_batch): batch columns
+    + routed rows + device results -> the byte-exact record stream,
+    without the ~10 per-message numpy scatter arrays kme_recon_wire
+    needs. Returns (buf, line_off, msg_lines) like
+    SeqSession.process_wire_buffer, or None when unavailable."""
+    if not hasattr(lib, "kme_recon_batch"):
+        return None
+    c = ctypes
+    P64 = c.POINTER(c.c_int64)
+    P32 = c.POINTER(c.c_int32)
+    PU8 = c.POINTER(c.c_uint8)
+    pp = lambda a, t: a.ctypes.data_as(t)
+    i64 = lambda a: np.ascontiguousarray(a, np.int64)
+    nmsg = batch.n
+    nr = len(cols["msg_index"])
+    r_msg = i64(cols["msg_index"])
+    r_act = np.ascontiguousarray(cols["act"], np.int32)
+    r_lane = np.ascontiguousarray(cols["lane"], np.int32)
+    h_ok = np.ascontiguousarray(host["ok"], np.uint8)
+    h_append = np.ascontiguousarray(host["append"], np.uint8)
+    h_nfill, h_resid, h_prev = (i64(host[k]) for k in
+                                ("nfill", "residual", "prev_oid"))
+    f_oid, f_aidx, f_price, f_size = (i64(fills[j]) for j in range(4))
+    rc = lib.kme_recon_batch(
+        nmsg, pp(batch.action, P64), pp(batch.oid, P64),
+        pp(batch.aid, P64), pp(batch.sid, P64), pp(batch.price, P64),
+        pp(batch.size, P64), pp(batch.next, P64),
+        pp(batch.hnext, PU8), pp(batch.prev, P64),
+        pp(batch.hprev, PU8),
+        nr, pp(r_msg, P64), pp(r_act, P32), pp(r_lane, P32),
+        pp(h_ok, PU8), pp(h_nfill, P64), pp(h_resid, P64),
+        pp(h_prev, P64), pp(h_append, PU8),
+        len(lane_sid), pp(lane_sid, P64),
+        len(idx2aid), pp(idx2aid, P64),
+        fills.shape[1], pp(f_oid, P64), pp(f_aidx, P64),
+        pp(f_price, P64), pp(f_size, P64), handle)
+    if rc != 0:
+        raise RuntimeError(f"kme_recon_batch failed rc={rc}")
+    blen = lib.kme_recon_len(handle)
+    nlines = lib.kme_recon_n_lines(handle)
+    buf = c.string_at(lib.kme_recon_buf(handle), blen)
+    line_off = np.empty(nlines + 1, np.int64)
+    line_off[:nlines] = np.ctypeslib.as_array(
+        lib.kme_recon_line_off(handle), (nlines,))
+    line_off[nlines] = blen
+    msg_lines = np.ctypeslib.as_array(
+        lib.kme_recon_msg_lines(handle), (nmsg,)).copy()
+    return buf, line_off, msg_lines

@@ -20,9 +20,11 @@ Two artifact realities shape the loader:
   the report is still printed/written, but the exit code stays 0.
 
 Direction matters: throughput regresses by FALLING, latency by RISING.
-`pipeline_speedup` and overlap fractions are excluded from
-enforcement — they are ratios of two noisy quantities and flap across
-runs (the bench already emits pipeline_warning for visibility).
+`pipeline_speedup` stays advisory — it is a ratio of two wall clocks
+and flaps across runs. `measured_overlap_frac` IS gated since its
+redefinition over the collect wall (overlap / collect_wall converges
+structurally to ~1.0 under working double-buffering), as is `local_s`
+(the host-path wall the native layer exists to shrink).
 """
 
 from __future__ import annotations
@@ -45,11 +47,16 @@ GATED_METRICS = {
     "p50_ms": "down",
     "p90_ms": "down",
     "p99_ms": "down",
+    # host-path metrics (ISSUE r06): the wall the host spends off the
+    # device, and the fraction of collect wall hidden under device
+    # execution (defined over the collect wall, so it is stable enough
+    # to gate — unlike the wall-clock speedup ratio)
+    "local_s": "down",
+    "measured_overlap_frac": "up",
 }
 
 # reported-only: too noisy to gate on (documented flappers)
-ADVISORY_METRICS = ("pipeline_speedup", "measured_overlap_frac",
-                    "journal_overhead_frac")
+ADVISORY_METRICS = ("pipeline_speedup", "journal_overhead_frac")
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
 

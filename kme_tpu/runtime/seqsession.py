@@ -222,6 +222,10 @@ class NativeSeqRouter:
         self._lib = lib
         self._h = lib.kme_router_new(num_lanes, num_accounts)
         self._fin = weakref.finalize(self, lib.kme_router_free, self._h)
+        # bumped on every wholesale map import (checkpoint restore):
+        # SeqSession's recon-LUT cache keys on (map sizes, epoch), and
+        # sizes alone can collide across an import
+        self._map_epoch = 0
 
     # -- map views (checkpoint save/load reads+writes these) -----------
     def _export(self, nfn, efn, vdt):
@@ -239,6 +243,7 @@ class NativeSeqRouter:
     def _import(self, ifn, d, vdt):
         import ctypes
 
+        self._map_epoch += 1
         keys = np.fromiter(d.keys(), np.int64, len(d))
         vals = np.fromiter(d.values(), vdt, len(d))
         P64 = ctypes.POINTER(ctypes.c_int64)
@@ -422,9 +427,20 @@ class SeqSession:
     def _plan(self, msgs):
         """Route + pack: columnar router output -> the stacked (K, B)
         i32 input planes of one scan dispatch. Returns
-        (cols, host_rejects, stacked, cnts, K)."""
+        (cols, host_rejects, stacked, cnts, K). Fixed-mode WireBatches
+        take the single-call native path (kme_plan_batch) when the
+        library is built; the numpy pack below is the byte-exact
+        fallback (and the only path for java mode, whose extra
+        aidr/sidr/flags planes ride the Python router)."""
         from kme_tpu.utils import pow2_bucket
 
+        if (isinstance(msgs, WireBatch)
+                and isinstance(self.router, NativeSeqRouter)):
+            from kme_tpu.native.sched import plan_batch
+
+            r = plan_batch(self.router, msgs, self.cfg.batch)
+            if r is not None:
+                return r
         cols, host_rejects = self.router.route(msgs)
         n = len(cols["act"])
         B = self.cfg.batch
@@ -547,9 +563,13 @@ class SeqSession:
                 raise ValueError(
                     "pipelined serving requires int64-range ids — "
                     "route beyond-int64 streams through process_wire")
-        cols, host_rejects, stacked, cnts, K = self._plan(msgs)
-        self.state, outp = SQ.build_seq_scan(self.cfg, K)(
-            self.state, stacked)
+        with self.timer.phase("plan_s"):
+            cols, host_rejects, stacked, cnts, K = self._plan(msgs)
+        with self.timer.phase("dispatch_s"):
+            # async enqueue: NO block_until_ready here — the device
+            # runs this batch while the host plans/collects others
+            self.state, outp = SQ.build_seq_scan(self.cfg, K)(
+                self.state, stacked)
         self.windows.append(("submit", self._n_submit, t0,
                              perf_counter()))
         self._n_submit += 1
@@ -563,9 +583,11 @@ class SeqSession:
 
         t0 = perf_counter()
         batch, cols, host_rejects, outp, cnts, K = handle
-        host, fills = self._fetch_outputs(outp, cnts, K)
-        r = self._recon_buffer(batch, cols, host_rejects, host,
-                               fills)
+        with self.timer.phase("fetch_s"):
+            host, fills = self._fetch_outputs(outp, cnts, K)
+        with self.timer.phase("recon_s"):
+            r = self._recon_buffer(batch, cols, host_rejects, host,
+                                   fills)
         self.windows.append(("collect", self._n_collect, t0,
                              perf_counter()))
         self._n_collect += 1
@@ -605,12 +627,43 @@ class SeqSession:
                                    fills)
         return r
 
+    def _recon_luts(self):
+        """lane -> sid and account-idx -> aid LUTs for reconstruction,
+        cached against the router's id-map sizes: the maps only grow
+        (REMOVE_SYMBOL wipes books, not the lane mapping), and
+        exporting them was O(accounts) dict traffic per batch on the
+        hot path. Wholesale imports (checkpoint restore) bump
+        _map_epoch, so same-size-different-content restores can never
+        serve a stale cache; Python routers are uncached (their dicts
+        mutate without a hook)."""
+        r = self.router
+        key = None
+        if isinstance(r, NativeSeqRouter):
+            key = (int(r._lib.kme_router_n_symbols(r._h)),
+                   int(r._lib.kme_router_n_accounts(r._h)),
+                   r._map_epoch)
+            cached = getattr(self, "_lut_cache", None)
+            if cached is not None and cached[0] == key:
+                return cached[1], cached[2]
+        lut = np.zeros(self.cfg.lanes, np.int64)
+        for lane, sid in r.sid_of_lane().items():
+            lut[lane] = sid
+        idx2aid = np.array(r.acct_of_idx() or [0], np.int64)
+        if key is not None:
+            self._lut_cache = (key, lut, idx2aid)
+        return lut, idx2aid
+
     def _recon_buffer(self, batch, cols, host_rejects, host, fills):
         """Columnar inputs + device results -> the byte-exact record
-        stream via the native C++ reconstructor (kme_wire.cpp)."""
+        stream via the native C++ reconstructor (kme_wire.cpp).
+        Prefers the one-pass kme_recon_batch entry (a single merge
+        walk in C++, no numpy scatter); the kme_recon_wire scatter
+        path below remains as the fallback for libraries built from
+        older sources."""
         import ctypes
 
         from kme_tpu.native import load_library
+        from kme_tpu.native.sched import recon_batch
 
         lib = load_library()
         if lib is None:
@@ -622,6 +675,19 @@ class SeqSession:
         self.last_reasons = reject_reason_codes(
             nmsg, cols["msg_index"], cols["act"], host["ok"],
             host["cap_reject"], host_rejects)
+        if self._recon is None:
+            import weakref
+
+            self._recon = lib.kme_recon_new()
+            # release the native buffer with the session (no __del__:
+            # a finalizer survives interpreter-shutdown ordering)
+            self._recon_fin = weakref.finalize(
+                self, lib.kme_recon_free, self._recon)
+        lane_sid, idx2aid = self._recon_luts()
+        r = recon_batch(lib, self._recon, batch, cols, host, fills,
+                        lane_sid, idx2aid)
+        if r is not None:
+            return r
         m_action, m_oid, m_aid = batch.action, batch.oid, batch.aid
         m_sid, m_price, m_size = batch.sid, batch.price, batch.size
         m_next, m_hnext = batch.next, batch.hnext
@@ -659,14 +725,6 @@ class SeqSession:
         f_price = np.ascontiguousarray(fills[2])
         f_size = np.ascontiguousarray(fills[3])
 
-        if self._recon is None:
-            import weakref
-
-            self._recon = lib.kme_recon_new()
-            # release the native buffer with the session (no __del__:
-            # a finalizer survives interpreter-shutdown ordering)
-            self._recon_fin = weakref.finalize(
-                self, lib.kme_recon_free, self._recon)
         c = ctypes
         P64 = c.POINTER(c.c_int64)
         P32 = c.POINTER(c.c_int32)
