@@ -41,4 +41,15 @@ parallel/) import `kme_tpu._jaxsetup` which enables x64 once.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("KME_LOCKCHECK") == "1":
+    # opt-in lock-order recorder: must patch threading.Lock/RLock
+    # before any kme_tpu module allocates a lock, hence here at the
+    # package root. See kme_tpu/analysis/lockcheck.py; tier-1 runs
+    # with this set assert no inversions at session teardown.
+    from kme_tpu.analysis import lockcheck as _lockcheck
+
+    _lockcheck.install()
+
 from kme_tpu import opcodes  # noqa: F401

@@ -1,0 +1,354 @@
+"""AST rule families for kme-lint (hot-path, determinism, tracer).
+
+Every rule carries a stable ID (the baseline and the gate key on it)
+and is scoped: hot-path rules fire only inside the pipelined submit
+window (HOT_SCOPES), determinism rules only inside replay-affecting
+functions (REPLAY_SCOPES), tracer rules only under engine/ and ops/
+(the jit/Pallas surface). Scopes are named per file so a refactor that
+moves a function out of the hot window stops linting it — the rule
+follows the architecture, not the text.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from kme_tpu.analysis import Finding
+
+# -- rule registry ----------------------------------------------------------
+
+RULES: Dict[str, str] = {
+    "KME-H001": "host sync (block_until_ready / device_get / "
+                "np.asarray on device values / .item()) inside the "
+                "pipelined submit window",
+    "KME-H002": "blocking I/O (sleep, print, open, fsync, flush, "
+                "subprocess) inside the pipelined submit window",
+    "KME-D001": "wall clock (time.time/time_ns, datetime.now) in a "
+                "replay-affecting path",
+    "KME-D002": "nondeterminism source (random, np.random, uuid, "
+                "os.urandom, secrets) in a replay-affecting path",
+    "KME-T001": "Python-level branch on a traced value (if/while/assert "
+                "over a jnp/lax expression) in engine/ or ops/",
+    "KME-T002": "implicit dtype — array creation without dtype= (drifts "
+                "to float64/int64 under x64) in engine/ or ops/",
+    "KME-T003": "width-unstable dtype (dtype=int/float, astype(int/"
+                "float), float64) in engine/ or ops/",
+    "KME-L001": "lock-order cycle in the static acquisition graph",
+    "KME-L002": "attribute mutated from multiple threads without a "
+                "common lock",
+}
+
+# -- scope tables -----------------------------------------------------------
+#
+# Hot scopes: the submit half of the double-buffered pipeline — between
+# a batch's fetch and its device dispatch, any host sync or blocking
+# I/O serializes the pipeline and shows up as measured_overlap_frac
+# collapse. Collect-side functions (_collect_one, collect,
+# _fetch_outputs) legitimately sync and are NOT listed.
+HOT_SCOPES: Dict[str, Set[str]] = {
+    "kme_tpu/bridge/service.py": {"_step_pipelined", "_parse_batch"},
+    "kme_tpu/runtime/seqsession.py": {"submit", "_plan"},
+    "kme_tpu/native/sched.py": {"plan_batch"},
+}
+
+# Replay scopes: functions whose outputs must be bit-identical when a
+# crash-resume replays the MatchIn tail — journal replay/derivation,
+# checkpoint restore, and (epoch, out_seq) stamp regeneration. A wall
+# clock or RNG here diverges the replay from the original run and the
+# broker dedups the wrong records.
+REPLAY_SCOPES: Dict[str, Set[str]] = {
+    "kme_tpu/telemetry/journal.py": {
+        "_resume_tail", "rewind_to_offset", "oracle_events",
+        "batch_events", "canonical_lines", "iter_events",
+        "read_events"},
+    "kme_tpu/bridge/broker.py": {"_load_topic"},
+    "kme_tpu/bridge/service.py": {"_init_exactly_once", "_try_resume"},
+    "kme_tpu/runtime/checkpoint.py": {
+        "load_session", "load_seq_session", "load_native",
+        "load_oracle", "snapshot_extra", "oldest_retained_offset"},
+}
+
+# Tracer scopes: whole directories — everything under them runs (or is
+# staged to run) under jit/vmap/scan/pallas_call.
+TRACED_DIRS = ("kme_tpu/engine/", "kme_tpu/ops/")
+
+_HOST_SYNC_ATTRS = {"block_until_ready", "device_get", "item"}
+_HOST_SYNC_NP = {"asarray", "array", "copy"}
+_BLOCKING_CALLS = {
+    ("time", "sleep"), ("os", "fsync"), ("os", "fdatasync"),
+    ("subprocess", "run"), ("subprocess", "check_output"),
+    ("subprocess", "Popen"), ("subprocess", "call"),
+}
+_BLOCKING_METHOD_ATTRS = {"write", "flush", "fsync", "sendall",
+                          "recv", "readline"}
+_WALLCLOCK = {("time", "time"), ("time", "time_ns"),
+              ("time", "clock_gettime"), ("datetime", "now"),
+              ("datetime", "utcnow"), ("datetime", "today")}
+_RANDOM_MODULES = {"random", "secrets", "uuid"}
+_IMPLICIT_CTORS = {"zeros", "ones", "empty", "full", "arange",
+                   "linspace", "array", "asarray", "fromiter"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _RuleVisitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, src_lines: List[str]) -> None:
+        self.relpath = relpath
+        self.lines = src_lines
+        self.findings: List[Finding] = []
+        self._scope: List[str] = []
+        self.hot_fns = HOT_SCOPES.get(relpath, set())
+        self.replay_fns = REPLAY_SCOPES.get(relpath, set())
+        self.traced = relpath.startswith(TRACED_DIRS)
+
+    # -- bookkeeping ----------------------------------------------------
+
+    def _scope_name(self) -> str:
+        return ".".join(self._scope) if self._scope else "<module>"
+
+    def _in(self, table: Set[str]) -> bool:
+        return any(name in table for name in self._scope)
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        self.findings.append(Finding(
+            rule=rule, path=self.relpath, line=line,
+            col=getattr(node, "col_offset", 0),
+            scope=self._scope_name(), message=message,
+            snippet=snippet))
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def _visit_fn(self, node) -> None:
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- H/D families (call-shaped) -------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func) or ""
+        head, _, tail = dotted.partition(".")
+        if self._in(self.hot_fns):
+            self._check_hot_call(node, dotted, head, tail)
+        if self._in(self.replay_fns):
+            self._check_replay_call(node, dotted, head, tail)
+        if self.traced:
+            self._visit_traced_call(node)
+        self.generic_visit(node)
+
+    def _check_hot_call(self, node, dotted, head, tail) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _HOST_SYNC_ATTRS:
+            self._emit("KME-H001", node,
+                       f"'{node.func.attr}()' forces a host/device "
+                       f"sync inside the submit window")
+            return
+        if head in ("np", "numpy", "jnp") and tail in _HOST_SYNC_NP:
+            self._emit("KME-H001", node,
+                       f"'{dotted}()' materializes on host inside the "
+                       f"submit window (device values block here)")
+            return
+        if dotted in ("jax.device_get",):
+            self._emit("KME-H001", node,
+                       "'jax.device_get()' inside the submit window")
+            return
+        if (head, tail) in _BLOCKING_CALLS or head == "subprocess":
+            self._emit("KME-H002", node,
+                       f"blocking call '{dotted}()' inside the submit "
+                       f"window")
+            return
+        if dotted in ("print", "open", "input"):
+            self._emit("KME-H002", node,
+                       f"blocking I/O '{dotted}()' inside the submit "
+                       f"window")
+            return
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _BLOCKING_METHOD_ATTRS:
+            self._emit("KME-H002", node,
+                       f"blocking I/O method '.{node.func.attr}()' "
+                       f"inside the submit window")
+
+    def _check_replay_call(self, node, dotted, head, tail) -> None:
+        if (head, tail) in _WALLCLOCK or dotted in (
+                "datetime.datetime.now", "datetime.datetime.utcnow"):
+            self._emit("KME-D001", node,
+                       f"wall clock '{dotted}()' in a replay-affecting "
+                       f"path (replay would diverge from the original "
+                       f"run)")
+            return
+        if head in _RANDOM_MODULES or dotted.startswith(
+                ("np.random", "numpy.random", "os.urandom")):
+            self._emit("KME-D002", node,
+                       f"nondeterminism source '{dotted}()' in a "
+                       f"replay-affecting path")
+
+    # -- T family (engine/ops only) -------------------------------------
+
+    def _test_is_traced(self, test: ast.AST) -> Optional[str]:
+        """A jnp./lax./jax.-built expression used as a Python bool —
+        under trace this raises ConcretizationTypeError (or silently
+        constant-folds under np). Returns the offending dotted call."""
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call):
+                dotted = _dotted(sub.func) or ""
+                head = dotted.split(".", 1)[0]
+                if head in ("jnp", "lax") or dotted.startswith(
+                        ("jax.numpy", "jax.lax")):
+                    return dotted
+        return None
+
+    def _check_branch(self, node, test) -> None:
+        if not self.traced:
+            return
+        dotted = self._test_is_traced(test)
+        if dotted:
+            kind = type(node).__name__.lower()
+            self._emit("KME-T001", node,
+                       f"Python-level {kind} on traced expression "
+                       f"'{dotted}(...)' — use lax.cond/jnp.where "
+                       f"(this either breaks under jit or silently "
+                       f"constant-folds)")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, node.test)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_branch(node, node.test)
+        self.generic_visit(node)
+
+    def _has_float_literal(self, node: ast.Call) -> bool:
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(a):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, float):
+                    return True
+        return False
+
+    def _check_dtype_value(self, node: ast.AST, where: ast.AST) -> None:
+        """dtype=int / dtype=float / dtype=np.float64 etc."""
+        if isinstance(node, ast.Name) and node.id in ("int", "float",
+                                                      "bool"):
+            if node.id != "bool":
+                self._emit("KME-T003", where,
+                           f"width-unstable dtype '{node.id}' (int64/"
+                           f"float64 under x64, int32 on some hosts) — "
+                           f"name the width explicitly")
+            return
+        dotted = _dotted(node) or ""
+        if dotted.endswith(("float64", "double", "intp", "int_",
+                            "longlong")):
+            self._emit("KME-T003", where,
+                       f"'{dotted}' in device code — engine arrays are "
+                       f"int32 (int64 only for money/oid paths, which "
+                       f"spell jnp.int64 via the _I64 alias)")
+
+    @staticmethod
+    def _is_fresh_numeric(node: ast.AST) -> bool:
+        """True when the expression builds fresh numeric data whose
+        width the ctor's default dtype decides: int/float literals
+        (not bool), unary minus on them, and list/tuple nests of
+        them."""
+        if isinstance(node, ast.Constant):
+            return type(node.value) in (int, float)
+        if isinstance(node, ast.UnaryOp) \
+                and isinstance(node.op, (ast.USub, ast.UAdd)):
+            return _RuleVisitor._is_fresh_numeric(node.operand)
+        if isinstance(node, (ast.List, ast.Tuple)):
+            return bool(node.elts) and all(
+                _RuleVisitor._is_fresh_numeric(e) for e in node.elts)
+        return False
+
+    def _visit_traced_call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func) or ""
+        head, _, tail = dotted.partition(".")
+        # T002: jnp/np array constructors with no dtype= — the result
+        # width depends on the x64 flag and the platform
+        if head in ("np", "numpy", "jnp") and tail in _IMPLICIT_CTORS:
+            has_dtype = any(kw.arg == "dtype" for kw in node.keywords)
+            # the dtype rides positionally for most ctors: 2nd arg of
+            # zeros/ones/empty/fromiter/array/asarray/arange(stop, dt),
+            # 3rd of full(shape, fill, dt)
+            if not has_dtype and tail in ("zeros", "ones", "empty",
+                                          "fromiter", "array",
+                                          "asarray") \
+                    and len(node.args) >= 2:
+                has_dtype = True
+            if not has_dtype and tail == "full" and len(node.args) >= 3:
+                has_dtype = True
+            # array/asarray of an existing array is dtype-PRESERVING —
+            # only fresh data (int/float literals, possibly nested in
+            # lists/tuples) picks up the drifting default width
+            if not has_dtype and tail in ("array", "asarray"):
+                if not (node.args
+                        and self._is_fresh_numeric(node.args[0])):
+                    has_dtype = True
+            if not has_dtype:
+                self._emit("KME-T002", node,
+                           f"'{dotted}()' without dtype= — defaults "
+                           f"drift (float64/int64 under x64); pin the "
+                           f"width")
+        # T003: explicit width-unstable dtypes
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                self._check_dtype_value(kw.value, node)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "astype" and node.args:
+            self._check_dtype_value(node.args[0], node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self.traced:
+            dotted = _dotted(node) or ""
+            if dotted in ("jnp.float64", "np.float64", "numpy.float64",
+                          "jnp.double", "np.double"):
+                self._emit("KME-T003", node,
+                           f"'{dotted}' reference in device code "
+                           f"(implicit float64 surface)")
+        self.generic_visit(node)
+
+
+def analyze_file(relpath: str, source: str) -> List[Finding]:
+    """Run the H/D/T rule families over one file. L-family findings
+    come from lockgraph.analyze_modules (cross-file)."""
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [Finding(rule="KME-E000", path=relpath,
+                        line=e.lineno or 1, col=e.offset or 0,
+                        scope="<module>",
+                        message=f"syntax error: {e.msg}", snippet="")]
+    v = _RuleVisitor(relpath, source.splitlines())
+    v.visit(tree)
+    # one finding per (rule, line): the dtype checks can fire twice on
+    # one expression (kw value + attribute walk)
+    seen, out = set(), []
+    for f in v.findings:
+        key = (f.rule, f.line)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

@@ -376,11 +376,19 @@ def chaos_main(argv=None) -> int:
     return _main(argv)
 
 
+def lint_main(argv=None) -> int:
+    """Repo-native static analysis (hot-path/determinism/tracer/lock
+    rules + ruff): see kme_tpu/analysis/."""
+    from kme_tpu.analysis.cli import main as _main
+
+    return _main(argv)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m kme_tpu.cli")
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
-        "supervise", "standby", "trace", "chaos", "top"))
+        "supervise", "standby", "trace", "chaos", "top", "lint"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
@@ -389,7 +397,7 @@ def main(argv=None) -> int:
             "consume": consume_main, "provision": provision_main,
             "supervise": supervise_main, "standby": standby_main,
             "trace": trace_main, "chaos": chaos_main,
-            "top": top_main,
+            "top": top_main, "lint": lint_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

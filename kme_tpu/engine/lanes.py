@@ -52,7 +52,6 @@ import kme_tpu._jaxsetup  # noqa: F401
 import jax
 import jax.numpy as jnp
 
-from kme_tpu import opcodes as op
 
 _I64 = jnp.int64
 _I32 = jnp.int32

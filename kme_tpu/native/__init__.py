@@ -27,6 +27,38 @@ _lib = None
 _lib_tried = False
 
 
+class BoundaryError(ValueError):
+    """A buffer about to cross the ctypes boundary is the wrong shape,
+    dtype, length, or layout. The C side reads exactly the lengths it
+    is told (kme_wire.cpp reads m_* to nmsg and r_*/h_* to nr with no
+    way to check), so a short or mis-typed buffer is a native-side
+    overread — this is raised Python-side instead."""
+
+
+def check_buffer(name, arr, dtype, n=None):
+    """Validate one array for a native call: exact dtype, C-contiguous,
+    1-D, and (when given) at least `n` elements. Returns the array so
+    call sites can validate inline."""
+    import numpy as np
+
+    if not isinstance(arr, np.ndarray):
+        raise BoundaryError(
+            f"{name}: expected ndarray, got {type(arr).__name__}")
+    if arr.dtype != np.dtype(dtype):
+        raise BoundaryError(
+            f"{name}: dtype {arr.dtype} != required {np.dtype(dtype)}")
+    if arr.ndim != 1:
+        raise BoundaryError(f"{name}: expected 1-D, got shape "
+                            f"{arr.shape}")
+    if not arr.flags["C_CONTIGUOUS"]:
+        raise BoundaryError(f"{name}: buffer is not C-contiguous")
+    if n is not None and arr.shape[0] < n:
+        raise BoundaryError(
+            f"{name}: {arr.shape[0]} element(s), native call reads "
+            f"{n} — short buffer would be an overread")
+    return arr
+
+
 def _build(srcs, out: str) -> bool:
     cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17"] + list(srcs)
            + ["-o", out])
@@ -52,6 +84,19 @@ def load_library() -> Optional[ctypes.CDLL]:
     _lib_tried = True
     if os.environ.get("KME_NATIVE", "1") == "0":
         return None
+    override = os.environ.get("KME_NATIVE_SO")
+    if override:
+        # explicit prebuilt library (sanitizer runs: scripts/
+        # build_native.py --sanitize emits an ASan/UBSan .so whose tag
+        # can't live in the normal cache); missing/unloadable is an
+        # ERROR, not a fallback — a sanitizer run that silently used
+        # the plain build would prove nothing
+        try:
+            _lib = _bind(ctypes.CDLL(override))
+        except OSError as e:
+            raise OSError(
+                f"KME_NATIVE_SO={override} could not be loaded: {e}")
+        return _lib
     try:
         h = hashlib.sha256()
         for src in _SRCS:

@@ -20,7 +20,7 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
-from kme_tpu.native import load_library
+from kme_tpu.native import BoundaryError, check_buffer, load_library
 from kme_tpu.runtime.sequencer import (
     Barrier, EnvelopeError, CapacityError, HostReject, Schedule,
 )
@@ -238,7 +238,13 @@ def plan_batch(router, batch, B: int):
         router._pack = pack
         router._pack_fin = weakref.finalize(router, lib.kme_pack_free,
                                             pack)
-    raw = {f: np.ascontiguousarray(getattr(batch, f))
+    # kme_plan_batch reads batch.n int64s from every column with no
+    # native-side length check: pin the dtype at conversion and verify
+    # the element count BEFORE handing out pointers
+    raw = {f: check_buffer(
+               f"plan_batch.{f}",
+               np.ascontiguousarray(getattr(batch, f), np.int64),
+               np.int64, batch.n)
            for f in ("action", "oid", "aid", "sid", "price", "size")}
     P64 = ctypes.POINTER(ctypes.c_int64)
     K = int(lib.kme_plan_batch(
@@ -295,6 +301,17 @@ def recon_batch(lib, handle, batch, cols, host, fills, lane_sid,
     i64 = lambda a: np.ascontiguousarray(a, np.int64)
     nmsg = batch.n
     nr = len(cols["msg_index"])
+    # kme_recon_batch reads the m_* columns to nmsg and the r_*/h_*
+    # rows to nr unconditionally (kme_wire.cpp): every pointer below is
+    # validated for dtype/contiguity/length first, so a short or
+    # mis-typed buffer raises here instead of overreading native-side
+    for f in ("action", "oid", "aid", "sid", "price", "size", "next",
+              "prev"):
+        check_buffer(f"recon_batch.{f}", getattr(batch, f),
+                     np.int64, nmsg)
+    for f in ("hnext", "hprev"):
+        check_buffer(f"recon_batch.{f}", getattr(batch, f),
+                     np.uint8, nmsg)
     r_msg = i64(cols["msg_index"])
     r_act = np.ascontiguousarray(cols["act"], np.int32)
     r_lane = np.ascontiguousarray(cols["lane"], np.int32)
@@ -302,7 +319,22 @@ def recon_batch(lib, handle, batch, cols, host, fills, lane_sid,
     h_append = np.ascontiguousarray(host["append"], np.uint8)
     h_nfill, h_resid, h_prev = (i64(host[k]) for k in
                                 ("nfill", "residual", "prev_oid"))
-    f_oid, f_aidx, f_price, f_size = (i64(fills[j]) for j in range(4))
+    for nm, a in (("cols.act", r_act), ("cols.lane", r_lane)):
+        check_buffer(f"recon_batch.{nm}", a, np.int32, nr)
+    for nm, a in (("host.ok", h_ok), ("host.append", h_append)):
+        check_buffer(f"recon_batch.{nm}", a, np.uint8, nr)
+    for nm, a in (("host.nfill", h_nfill), ("host.residual", h_resid),
+                  ("host.prev_oid", h_prev)):
+        check_buffer(f"recon_batch.{nm}", a, np.int64, nr)
+    check_buffer("recon_batch.lane_sid", lane_sid, np.int64)
+    check_buffer("recon_batch.idx2aid", idx2aid, np.int64)
+    if fills.ndim != 2 or fills.shape[0] != 4:
+        raise BoundaryError(
+            f"recon_batch.fills: expected shape (4, F), got "
+            f"{fills.shape}")
+    f_oid, f_aidx, f_price, f_size = (
+        check_buffer(f"recon_batch.fills[{j}]", i64(fills[j]),
+                     np.int64, fills.shape[1]) for j in range(4))
     rc = lib.kme_recon_batch(
         nmsg, pp(batch.action, P64), pp(batch.oid, P64),
         pp(batch.aid, P64), pp(batch.sid, P64), pp(batch.price, P64),

@@ -33,7 +33,6 @@ the reference analog of multiple Streams instances joining one group
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import numpy as np
 

@@ -17,7 +17,7 @@ first costs a tunnel RTT (~100ms+ through the driver's tunnel).
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List
 
 import numpy as np
 

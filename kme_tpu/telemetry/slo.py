@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import time
 
-from kme_tpu.telemetry.registry import LatencyHistogram, Registry
+from kme_tpu.telemetry.registry import Registry
 
 # stages the serving pipeline stamps (service.py); "e2e" spans broker
 # admission -> produce visible

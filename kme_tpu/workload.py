@@ -31,7 +31,7 @@ from __future__ import annotations
 import bisect
 import math
 import random
-from typing import Iterator, List, Optional
+from typing import Iterator, List
 
 from kme_tpu import opcodes as op
 from kme_tpu.wire import OrderMsg

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Build the native host-runtime library out-of-band.
+
+Normally kme_tpu.native.load_library() compiles on demand; this script
+exists for the two cases that need a *specific* build up front:
+
+  * CI warming the build cache:     python scripts/build_native.py
+  * sanitizer runs (ASan + UBSan):  python scripts/build_native.py --sanitize
+
+A sanitized .so cannot live in the normal cache (its tag would collide
+with the -O3 build of the same sources), so it is written next to the
+cache as kme_host_<tag>.asan.so and selected explicitly via the
+KME_NATIVE_SO environment variable. Because the Python interpreter
+itself is not instrumented, running under the sanitized library needs
+libasan preloaded; the script prints the exact recipe, which is:
+
+  LD_PRELOAD="$(gcc -print-file-name=libasan.so) \
+              $(g++ -print-file-name=libstdc++.so.6)" \
+  ASAN_OPTIONS=detect_leaks=0 \
+  KME_NATIVE_SO=<path> python -m pytest tests/test_wire_fuzz.py ...
+
+(leak checking is off because CPython "leaks" interned objects by
+design and the noise would bury real findings; heap-buffer-overflow,
+use-after-free and all UBSan checks stay fatal. libstdc++ rides in
+LD_PRELOAD too: python itself doesn't link it, so without it ASan's
+__cxa_throw interceptor can't resolve the real symbol at startup and
+aborts the process the first time a bundled C++ extension -- jaxlib's
+MLIR -- throws an exception.)
+"""
+
+import argparse
+import hashlib
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+NATIVE = os.path.join(REPO, "kme_tpu", "native")
+SRCS = [os.path.join(NATIVE, f) for f in
+        ("kme_host.cpp", "kme_oracle.cpp", "kme_wire.cpp",
+         "kme_router.cpp")]
+
+BASE = ["-shared", "-fPIC", "-std=c++17"]
+SAN = ["-g", "-O1", "-fno-omit-frame-pointer",
+       "-fsanitize=address,undefined", "-fno-sanitize-recover=all"]
+
+
+def source_tag() -> str:
+    h = hashlib.sha256()
+    for src in SRCS:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sanitize", action="store_true",
+                    help="ASan+UBSan build (kme_host_<tag>.asan.so)")
+    ap.add_argument("--cxx", default=os.environ.get("CXX", "g++"))
+    ap.add_argument("--out", default=None,
+                    help="output path (default: the cache path "
+                         "load_library() uses, or .asan.so beside it)")
+    args = ap.parse_args(argv)
+
+    tag = source_tag()
+    build_dir = os.path.join(NATIVE, "_build")
+    os.makedirs(build_dir, exist_ok=True)
+    if args.out:
+        out = args.out
+    elif args.sanitize:
+        out = os.path.join(build_dir, f"kme_host_{tag}.asan.so")
+    else:
+        out = os.path.join(build_dir, f"kme_host_{tag}.so")
+
+    flags = BASE + (SAN if args.sanitize else ["-O3"])
+    cmd = [args.cxx] + flags + SRCS + ["-o", out]
+    print("+ " + " ".join(cmd), file=sys.stderr)
+    rc = subprocess.run(cmd).returncode
+    if rc != 0:
+        return rc
+    print(out)
+    if args.sanitize:
+        def probe(flag):
+            r = subprocess.run([args.cxx, flag], capture_output=True,
+                               text=True)
+            return r.stdout.strip()
+
+        libasan = probe("-print-file-name=libasan.so") or "libasan.so"
+        libcxx = (probe("-print-file-name=libstdc++.so.6")
+                  or "libstdc++.so.6")
+        print(f"\nrun tests under it with:\n"
+              f"  LD_PRELOAD=\"{libasan} {libcxx}\" \\\n"
+              f"  ASAN_OPTIONS=detect_leaks=0 \\\n"
+              f"  KME_NATIVE_SO={out} \\\n"
+              f"  python -m pytest tests/test_wire_fuzz.py "
+              f"tests/test_host_path.py -q", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

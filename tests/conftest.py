@@ -32,3 +32,15 @@ def cpu_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
     return devs
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockcheck_gate():
+    """When tier-1 runs under KME_LOCKCHECK=1 (kme_tpu/__init__ patched
+    the lock factories), fail the session if any lock-order inversion
+    was observed across the whole run."""
+    yield
+    from kme_tpu.analysis import lockcheck
+
+    if lockcheck.enabled():
+        lockcheck.assert_clean()
