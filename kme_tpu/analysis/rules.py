@@ -49,7 +49,12 @@ RULES: Dict[str, str] = {
 HOT_SCOPES: Dict[str, Set[str]] = {
     "kme_tpu/bridge/service.py": {"_step_pipelined", "_parse_batch"},
     "kme_tpu/runtime/seqsession.py": {"submit", "_plan"},
-    "kme_tpu/native/sched.py": {"plan_batch"},
+    "kme_tpu/native/sched.py": {"plan_batch", "apply_placement"},
+    # the mesh planner + elastic placement decision run per batch on
+    # the host between dispatches; the MIGRATION executors
+    # (_migrate/_maybe_rebalance) legitimately sync the state pytree
+    # and are NOT listed, like the collect-side functions above
+    "kme_tpu/parallel/seqmesh.py": {"plan_windows", "plan_rebalance"},
 }
 
 # Replay scopes: functions whose outputs must be bit-identical when a
@@ -67,6 +72,12 @@ REPLAY_SCOPES: Dict[str, Set[str]] = {
     "kme_tpu/runtime/checkpoint.py": {
         "load_session", "load_seq_session", "load_native",
         "load_oracle", "snapshot_extra", "oldest_retained_offset"},
+    # the elastic placement decision must be RNG-free: a migration is
+    # replayed as part of the batch sequence, and a random tie-break
+    # would put lanes on different shards across original vs resumed
+    # runs (harmless for MatchOut bytes, but it diverges the per-shard
+    # telemetry and the planner's window stream — keep it deterministic)
+    "kme_tpu/parallel/seqmesh.py": {"plan_rebalance"},
 }
 
 # Tracer scopes: whole directories — everything under them runs (or is

@@ -208,6 +208,22 @@ class NativeScheduler:
         return {lane: sid for sid, lane in self.sid_lane.items()}
 
 
+def apply_placement(perm, lanes, s_local: int):
+    """Apply the mesh planner's elastic placement table to a routed
+    lane column in one vectorized pass: global lane -> global slot
+    (`perm[lane]`), then (shard, local_row) = divmod(slot, s_local).
+
+    This is the host-path mirror of SeqMeshSession.plan_windows'
+    placement application (parallel/seqmesh.py); like plan_batch /
+    recon_batch above, its eventual native home is kme_host.cpp —
+    the numpy fancy-index form here is the semantics authority and is
+    already allocation-light enough for the planner's hot scope.
+    Returns (slot, shard, local_row), each shaped like `lanes`."""
+    lanes64 = lanes.astype(np.int64, copy=False)
+    slot = perm[lanes64]
+    return slot, slot // s_local, slot % s_local
+
+
 # -- batch host-path entry points (one C++ call per stage) ----------------
 #
 # The serve/bench hot loop's host work — envelope check + route + H2D

@@ -26,6 +26,21 @@ surfaces globally; WHICH error wins when several shards fail in one
 window is unspecified, unlike the serial engine's first-error rule —
 the error path aborts the stream either way).
 
+ELASTIC PLACEMENT (this round): lanes are no longer pinned to shards
+by the static `global_lane // S_local` layout. A placement table
+(`_perm`: global lane -> global slot; shard = slot // S_local) starts
+as the identity — byte-identical to the old static layout — and a
+per-lane load EWMA drives BETWEEN-BATCH migrations of hot lanes to
+underloaded shards (plan_rebalance decides, _migrate permutes the
+sharded lane axis of the state pytree through the engine's canonical
+codec). Correctness is placement-INDEPENDENT: the engine is a
+deterministic state machine, so any symbol->shard assignment that
+preserves the global application order and the per-window
+account-disjointness invariant above yields byte-identical MatchOut —
+which is what lets the planner rebalance aggressively and the tests
+gate on oracle parity WITH migrations observed
+(tests/test_shard_elastic.py, kme-bench --suite shards).
+
 Executed evidence: tests/test_seqmesh.py (bit-exact at shards 1/2/8 on
 a virtual mesh vs the scalar oracle and the single-chip SeqSession),
 tests/test_multihost.py (the same program SPMD across two OS
@@ -36,7 +51,8 @@ multichip artifact).
 from __future__ import annotations
 
 import functools
-from typing import Dict, List
+import time
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -46,6 +62,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from kme_tpu.engine import seq as SQ
+from kme_tpu.native import sched as native_sched
 from kme_tpu.parallel.mesh import AXIS, build_mesh
 from kme_tpu.runtime.seqsession import SeqSession, make_seq_router
 from kme_tpu.telemetry import PhaseTimer, Registry
@@ -54,6 +71,15 @@ from kme_tpu.utils import pow2_bucket
 # per-shard per-window message capacity (windows close earlier on
 # account conflicts; 128 keeps the padded input planes small)
 WINDOW_CAP = 128
+
+# rebalance when the hottest shard's EWMA load exceeds the mean by
+# this factor; migrating costs a full canonical round-trip of the lane
+# state, so the trigger is deliberately above measurement noise
+REBALANCE_THRESHOLD = 1.25
+# per-batch decay of the per-lane load estimate
+LOAD_EWMA_ALPHA = 0.5
+# matchable messages (BUY/SELL) sweep makers; everything else is O(1)
+MATCH_WORK_WEIGHT = 2.0
 
 _MSG_FIELDS = ("act", "aid", "price", "size", "lane",
                "oid_lo", "oid_hi")
@@ -152,6 +178,63 @@ def build_seq_mesh_scan(local_cfg: SQ.SeqConfig, shards: int, K: int):
     return jax.jit(sharded)   # outs: (K, shards, NROWS, 128) replicated
 
 
+def plan_rebalance(lane_load, perm, shards: int,
+                   threshold: float = REBALANCE_THRESHOLD,
+                   max_swaps: Optional[int] = None):
+    """Pure placement decision: given the per-lane load EWMA and the
+    current placement table, return a new table (or None for "stay").
+
+    Greedy slot swaps between the hottest and coldest shard, accepted
+    only while each swap STRICTLY reduces that pair's peak load, so the
+    loop terminates and a balanced table is a fixed point. Fully
+    deterministic (argmax/argmin first-index ties, no RNG) — the
+    decision is replay-safe by construction, which kme-lint's KME-D002
+    replay scope pins.
+    """
+    S = len(perm)
+    Sl = S // shards
+    total = float(lane_load.sum())
+    if total <= 0.0:
+        return None
+    shard_loads = np.bincount(perm // Sl, weights=lane_load,
+                              minlength=shards).astype(float)
+    mean = total / shards
+    if shard_loads.max() <= threshold * mean:
+        return None
+    new = perm.copy()
+    budget = S if max_swaps is None else max_swaps
+    swapped = False
+    for _ in range(budget):
+        h = int(shard_loads.argmax())
+        c = int(shard_loads.argmin())
+        if h == c:
+            break
+        # best single lane swap hot<->cold: minimize the pair's peak
+        best = None
+        for gh in range(S):
+            if new[gh] // Sl != h:
+                continue
+            for gc in range(S):
+                if new[gc] // Sl != c:
+                    continue
+                d = float(lane_load[gh]) - float(lane_load[gc])
+                if d <= 0.0:
+                    continue
+                peak = max(shard_loads[h] - d, shard_loads[c] + d)
+                if peak >= shard_loads[h]:
+                    continue
+                if best is None or peak < best[0]:
+                    best = (peak, gh, gc, d)
+        if best is None:
+            break
+        _, gh, gc, d = best
+        new[gh], new[gc] = new[gc], new[gh]
+        shard_loads[h] -= d
+        shard_loads[c] += d
+        swapped = True
+    return new if swapped else None
+
+
 class SeqMeshSession(SeqSession):
     """Sharded drop-in for SeqSession (fixed mode): same process /
     process_wire / process_wire_buffer surface, state sharded over a
@@ -160,7 +243,13 @@ class SeqMeshSession(SeqSession):
     scale-out serving/validation path (export_state intentionally
     unsupported)."""
 
-    def __init__(self, cfg: SQ.SeqConfig, shards: int) -> None:
+    # replicated state keys: migration must NOT permute these
+    _REPL_KEYS = ("bal_lo", "bal_hi", "bal_u", "err")
+
+    def __init__(self, cfg: SQ.SeqConfig, shards: int, *,
+                 rebalance: bool = True,
+                 rebalance_threshold: float = REBALANCE_THRESHOLD,
+                 ) -> None:
         if cfg.compat != "fixed":
             raise ValueError(
                 "sharded seq serving is fixed-mode only (java mode is "
@@ -188,6 +277,21 @@ class SeqMeshSession(SeqSession):
         self.phases = self.timer.totals   # cumulative across batches
         self._use_native_wire = True
         self._ghint = 8
+        # elastic placement: global lane -> global slot; shard of a
+        # lane is perm[lane] // S_local, its kernel row perm[lane] %
+        # S_local. Identity == the pre-elastic static layout.
+        self.rebalance = rebalance
+        self.rebalance_threshold = rebalance_threshold
+        self._perm = np.arange(cfg.lanes, dtype=np.int64)
+        self._lane_load = np.zeros(cfg.lanes, np.float64)
+        # sticky account home: last GLOBAL LANE the account traded on
+        # (tracked as a lane, not a shard, so homes follow migrations)
+        self._acct_lane: Dict[int, int] = {}
+        self._migrations = 0
+        self._rebalances = 0
+        self._occ_shard = np.zeros(shards, np.int64)
+        self._hist_shard = np.zeros(
+            (shards, SQ.N_HIST, SQ.N_HIST_BUCKETS), np.int64)
 
     # -- host planning -------------------------------------------------
 
@@ -195,6 +299,15 @@ class SeqMeshSession(SeqSession):
         """Columnar routed messages -> (wins dict of (K, shards*Bw) i32,
         placements list of (window, shard, pos) per routed message,
         cnts (K, shards) int).
+
+        A lane's shard and kernel row come from the elastic placement
+        table (`_perm`, applied once per batch via
+        native_sched.apply_placement), NOT the old static
+        `lane // S_local` split. Laneless balance messages (CREATE/
+        TRANSFER) follow the account's sticky home lane, which is the
+        last lane it traded on — tracked as a LANE so a migration
+        automatically re-pins the account to the lane's new shard and
+        the balance-coupling window invariant survives rebalancing.
 
         The planner is host Python (per-message loop): fine for the
         dryrun/test scale this session targets; a measured multi-chip
@@ -205,6 +318,8 @@ class SeqMeshSession(SeqSession):
         acts = cols["act"]
         lanes = cols["lane"]
         aids = cols["aid"]
+        _, shard_col, local_col = native_sched.apply_placement(
+            self._perm, lanes, self.S_local)
         barrier = ((acts == SQ.L_PAYOUT_YES) | (acts == SQ.L_PAYOUT_NO)
                    | (acts == SQ.L_REMOVE_SYMBOL))
         laneful = ((acts == SQ.L_BUY) | (acts == SQ.L_SELL)
@@ -231,15 +346,16 @@ class SeqMeshSession(SeqSession):
             if barrier[k]:
                 # barriers credit many accounts: run alone
                 flush()
-                s = int(lanes[k]) // self.S_local
-                cur[s].append(k)
+                cur[int(shard_col[k])].append(k)
                 flush()
                 continue
             a = int(aids[k])
             if laneful[k]:
-                s = int(lanes[k]) // self.S_local
+                s = int(shard_col[k])
+                if binds[k]:
+                    self._acct_lane[a] = int(lanes[k])
             else:
-                s = bound.get(a, a % self.shards)
+                s = bound.get(a, self._home_shard(a))
             b = bound.get(a) if binds[k] else None
             if (b is not None and b != s) or len(cur[s]) >= Bw:
                 flush()
@@ -261,8 +377,7 @@ class SeqMeshSession(SeqSession):
                     wins["aid"][w, s, p] = cols["aid"][k]
                     wins["price"][w, s, p] = cols["price"][k]
                     wins["size"][w, s, p] = cols["size"][k]
-                    wins["lane"][w, s, p] = (int(cols["lane"][k])
-                                             % self.S_local)
+                    wins["lane"][w, s, p] = int(local_col[k])
                     oid = int(cols["oid"][k])
                     lo = oid & 0xFFFFFFFF
                     wins["oid_lo"][w, s, p] = np.int32(
@@ -278,14 +393,21 @@ class SeqMeshSession(SeqSession):
     def _run(self, msgs):
         from kme_tpu.runtime.session import LaneEngineError
 
+        # migrations happen BETWEEN batches only: state is quiescent
+        # here, so the permutation is a pure relabeling of lane rows
+        self._maybe_rebalance()
+
         with self.timer.phase("plan_s"):
             cols, host_rejects = self.router.route(msgs)
+            self._note_load(cols)
             wins, placements, cnts, K = self.plan_windows(cols)
 
         with self.timer.phase("dispatch_s"):
+            t_disp = time.perf_counter()
             scan = build_seq_mesh_scan(self.local_cfg, self.shards, K)
             self.state, outs = scan(self.state, wins)
             jax.block_until_ready(self.state)
+            disp_wall = time.perf_counter() - t_disp
 
         with self.timer.phase("fetch_s"):
             outs = np.asarray(outs)   # (K, shards, NROWS, 128)
@@ -297,8 +419,17 @@ class SeqMeshSession(SeqSession):
                      ("nfill", np.int64), ("prev_oid", np.int64))}
             groups = {}
             mets = np.zeros(SQ.N_METRICS, np.int64)
-            # per-(window, shard) kernel calls are the dispatch units
-            # here, so batch_occupancy observes per-shard sub-windows
+            # batch_occupancy convention (documented + tested,
+            # tests/test_shard_elastic.py): per-(window, shard) kernel
+            # calls are the dispatch units here, so batch_occupancy
+            # observes per-shard SUB-WINDOWS — one observation per
+            # non-empty (w, s) cell, valued at that cell's message
+            # count cnts[w, s], NOT one blended observation per host
+            # batch like the single-chip session. The same counters
+            # accumulate per shard into _hist_shard and surface as
+            # batch_occupancy_shard{N} (histograms()); the cumulative
+            # per-shard occupancy totals (_occ_shard) feed the
+            # shard_imbalance gauge = max/mean per-shard occupancy.
             hists = np.zeros((SQ.N_HIST, SQ.N_HIST_BUCKETS), np.int64)
             for w in range(K):
                 for s in range(self.shards):
@@ -316,8 +447,11 @@ class SeqMeshSession(SeqSession):
                                           ([0], np.cumsum(res["nfill"]))))
                     mets += res["metrics"]
                     hists += res["hist"]
+                    self._hist_shard[s] += res["hist"]
             self._metrics += mets
             self._hist += hists
+            self._publish_shard_telemetry(
+                disp_wall, cnts.sum(axis=0).astype(np.int64))
             fills_parts = []
             for k, w, s, p in placements:
                 res, fills_ws, off = groups[(w, s)]
@@ -329,8 +463,174 @@ class SeqMeshSession(SeqSession):
                      else np.zeros((4, 0), np.int64))
         return cols, host_rejects, host, fills
 
+    # -- elastic placement ---------------------------------------------
+
+    def _home_shard(self, a: int) -> int:
+        """Shard for a laneless balance message: the account's sticky
+        home lane's CURRENT shard under the placement table, falling
+        back to the static hash for accounts that never traded."""
+        g = self._acct_lane.get(a)
+        if g is None:
+            return a % self.shards
+        return int(self._perm[g]) // self.S_local
+
+    def _note_load(self, cols) -> None:
+        """Fold this batch's routed messages into the per-lane load
+        EWMA. Matchable messages (BUY/SELL) weigh more: a taker can
+        sweep up to max_fills makers, everything else is O(1)."""
+        acts = cols["act"]
+        laneful = ((acts == SQ.L_BUY) | (acts == SQ.L_SELL)
+                   | (acts == SQ.L_CANCEL) | (acts == SQ.L_ADD_SYMBOL)
+                   | (acts == SQ.L_PAYOUT_YES)
+                   | (acts == SQ.L_PAYOUT_NO)
+                   | (acts == SQ.L_REMOVE_SYMBOL))
+        w = np.where((acts == SQ.L_BUY) | (acts == SQ.L_SELL),
+                     MATCH_WORK_WEIGHT, 1.0)
+        batch = np.bincount(
+            cols["lane"][laneful].astype(np.int64),
+            weights=w[laneful], minlength=self.cfg.lanes)
+        self._lane_load = (LOAD_EWMA_ALPHA * batch
+                           + (1.0 - LOAD_EWMA_ALPHA) * self._lane_load)
+
+    def _maybe_rebalance(self) -> None:
+        if not self.rebalance or self.shards == 1:
+            return
+        new = plan_rebalance(self._lane_load, self._perm, self.shards,
+                             threshold=self.rebalance_threshold)
+        if new is None:
+            return
+        with self.timer.phase("migrate_s"):
+            moved = self._migrate(new)
+        if moved:
+            self._rebalances += 1
+            self._migrations += moved
+
+    def _migrate(self, new_perm) -> int:
+        """Permute the sharded lane axis of the state pytree to the new
+        placement. Lane state moves WHOLESALE through the engine's
+        canonical codec (export_canonical / import_canonical per
+        shard): books, per-lane seq counters, and the lane-keyed
+        position hash are re-keyed for the destination shard's local
+        lane stride, while the replicated balance planes are untouched
+        — so the migrated mesh state replays byte-identically.
+        Returns the number of lanes that changed slot."""
+        old_perm = self._perm
+        moved = int((new_perm != old_perm).sum())
+        if not moved:
+            return 0
+        Sl, A = self.S_local, self.local_cfg.accounts
+        host = {k: np.asarray(v) for k, v in self.state.items()}
+        canons = []
+        for s in range(self.shards):
+            loc = {k: (v if k in self._REPL_KEYS
+                       else v.reshape(self.shards, -1, v.shape[-1])[s])
+                   for k, v in host.items()}
+            canons.append(SQ.export_canonical(self.local_cfg, loc))
+        # inverse of the NEW table: which global lane lands in slot g
+        inv_new = np.empty_like(new_perm)
+        inv_new[new_perm] = np.arange(len(new_perm),
+                                      dtype=new_perm.dtype)
+        parts = []
+        for s in range(self.shards):
+            src = []   # (old_shard, old_row) feeding each local row
+            for r in range(Sl):
+                g = int(inv_new[s * Sl + r])
+                o = int(old_perm[g])
+                src.append((o // Sl, o % Sl))
+            tgt = dict(canons[0])   # replicated planes from shard 0
+            for key in ("slot_oid", "slot_aid", "slot_price",
+                        "slot_size", "slot_seq", "slot_used"):
+                tgt[key] = np.stack(
+                    [canons[ss][key][rr] for ss, rr in src])
+            tgt["seq"] = np.stack(
+                [canons[ss]["seq"][rr] for ss, rr in src])
+            tgt["book_exists"] = np.stack(
+                [canons[ss]["book_exists"][rr] for ss, rr in src])
+            for key in ("pos_amt", "pos_avail"):
+                tgt[key] = np.stack(
+                    [canons[ss][key].reshape(Sl, A)[rr]
+                     for ss, rr in src]).reshape(-1)
+            parts.append(SQ.import_canonical(self.local_cfg, tgt))
+        state = {}
+        for k in host:
+            if k in self._REPL_KEYS:
+                state[k] = parts[0][k]
+            else:
+                state[k] = jnp.concatenate(
+                    [parts[s][k] for s in range(self.shards)], axis=0)
+        self.state = state
+        self._perm = new_perm
+        return moved
+
+    # -- per-shard telemetry -------------------------------------------
+
+    def _publish_shard_telemetry(self, disp_wall: float, occ) -> None:
+        """Per-shard straggler attribution. The mesh scan is lockstep
+        (one shard_map dispatch), so per-chip walls are not separately
+        measurable from the host — attribution charges each shard an
+        occupancy-weighted share of the batch's dispatch wall, the
+        psum-mergeable convention the on-device histogram counters
+        already use. shard_imbalance = cumulative max/mean per-shard
+        occupancy (1.0 == perfectly balanced)."""
+        self._occ_shard += occ
+        reg = self.telemetry
+        reg.gauge("shard_count", "mesh shard count").set(self.shards)
+        reg.counter("shard_migrations_total",
+                    "lane slots moved by elastic placement"
+                    ).set(self._migrations)
+        reg.counter("shard_rebalances_total",
+                    "between-batch rebalance events"
+                    ).set(self._rebalances)
+        tot = int(self._occ_shard.sum())
+        if tot:
+            reg.gauge(
+                "shard_imbalance",
+                "max/mean per-shard cumulative occupancy").set(
+                round(float(self._occ_shard.max())
+                      * self.shards / tot, 4))
+        btot = int(occ.sum())
+        for s in range(self.shards):
+            reg.gauge(f"shard{s}_occupancy",
+                      "cumulative messages executed on shard").set(
+                int(self._occ_shard[s]))
+            if btot and int(occ[s]):
+                reg.latency(
+                    f"device_shard{s}",
+                    "occupancy-weighted device wall share").observe(
+                    disp_wall * float(occ[s]) / btot, n=int(occ[s]))
+
+    def shard_stats(self) -> dict:
+        """Bench/report surface: per-shard occupancy + imbalance."""
+        tot = int(self._occ_shard.sum())
+        return {
+            "shards": self.shards,
+            "occupancy": self._occ_shard.tolist(),
+            "imbalance": (round(float(self._occ_shard.max())
+                                * self.shards / tot, 4)
+                          if tot else 0.0),
+            "migrations": self._migrations,
+            "rebalances": self._rebalances,
+        }
+
+    # -- the SeqSession metric surface ---------------------------------
+
+    def histograms(self) -> Dict[str, list]:
+        out = {name: self._hist[i].tolist()
+               for i, name in enumerate(SQ.HIST_NAMES)}
+        for s in range(self.shards):
+            for i, name in enumerate(SQ.HIST_NAMES):
+                out[f"{name}_shard{s}"] = self._hist_shard[s][i].tolist()
+        self.telemetry.publish_histograms(out)
+        return out
+
     def metrics(self) -> Dict[str, int]:
         counters = dict(zip(SQ.METRIC_NAMES, self._metrics.tolist()))
+        counters["shard_migrations"] = self._migrations
+        counters["shard_rebalances"] = self._rebalances
+        tot = int(self._occ_shard.sum())
+        if tot:
+            counters["shard_imbalance"] = round(
+                float(self._occ_shard.max()) * self.shards / tot, 4)
         self._publish(counters)
         return counters
 

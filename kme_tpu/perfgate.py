@@ -53,6 +53,10 @@ GATED_METRICS = {
     # to gate — unlike the wall-clock speedup ratio)
     "local_s": "down",
     "measured_overlap_frac": "up",
+    # elastic sharding (ISSUE r08): max/mean per-shard occupancy under
+    # the skewed suite — scale-free like measured_overlap_frac, so it
+    # gates tightly even on jittery shared runners
+    "shard_imbalance": "down",
 }
 
 # reported-only: too noisy to gate on (documented flappers)

@@ -12,8 +12,10 @@ nothing here adds instrumentation, it only reads:
 Shown: input throughput (rate computed between refreshes), per-stage
 latency quantiles (ingress/plan/device/produce/e2e/consume — the
 attribution pipeline in bridge/service.py), leader epoch and offset,
-SLO state, replica application lag, and the supervisor's restart
-history. `--once` prints a single plain-text frame (scriptable; the
+SLO state, per-shard occupancy/imbalance/migrations when the leader is
+a sharded mesh session (device_shard{N} + shard_imbalance,
+parallel/seqmesh.py), replica application lag, and the supervisor's
+restart history. `--once` prints a single plain-text frame (scriptable; the
 smoke test uses it); the default is a curses loop that redraws every
 --interval seconds and quits on `q`.
 """
@@ -178,6 +180,29 @@ def render(view: dict, width: int = 78) -> list:
                 f"{_fmt(v.get('p50_ms'), 3):>10s}"
                 f"{_fmt(v.get('p99_ms'), 3):>10s}"
                 f"{_fmt(v.get('p999_ms'), 3):>10s}")
+
+    # per-shard straggler attribution (SeqMeshSession telemetry):
+    # occupancy + migration gauges and the occupancy-weighted
+    # device_shard{N} latency summaries
+    nshards = _gauge(lead, "shard_count")
+    if nshards:
+        lines.append("")
+        lines.append(
+            f"  shards={_fmt(nshards, 0)} "
+            f"imbalance={_fmt(_gauge(lead, 'shard_imbalance'), 3)} "
+            f"migrations="
+            f"{_fmt(_counter(lead, 'shard_migrations_total'), 0)} "
+            f"rebalances="
+            f"{_fmt(_counter(lead, 'shard_rebalances_total'), 0)}")
+        lines.append(f"  {'shard':<9s}{'occupancy':>10s}{'p50 ms':>12s}"
+                     f"{'p99 ms':>12s}")
+        for s in range(int(nshards)):
+            v = lats.get(f"device_shard{s}") or {}
+            lines.append(
+                f"  {s:<9d}"
+                f"{_fmt(_gauge(lead, f'shard{s}_occupancy'), 0):>10s} "
+                f"{_fmt(v.get('p50_ms'), 3):>11s} "
+                f"{_fmt(v.get('p99_ms'), 3):>11s}")
 
     lines.append("")
     if stby.get("source"):

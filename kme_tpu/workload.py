@@ -228,6 +228,105 @@ def zipf_symbol_stream(num_events: int, num_symbols: int, num_accounts: int,
     return msgs
 
 
+def zipf_hot_stream(num_events: int, num_symbols: int, num_accounts: int,
+                    seed: int = 0, hot_frac: float = 0.7,
+                    zipf_a: float = 1.2,
+                    deposit: int = 10_000_000) -> List[OrderMsg]:
+    """Adversarial profile for static sharding: ONE hot book. Symbol 0
+    takes `hot_frac` of all events outright; the remainder is
+    Zipf-distributed over symbols 1..n-1, so there is a distinctly WARM
+    second-ranked book — the shape that defeats `lane % shards`
+    placement twice over (the hot symbol saturates its shard AND the
+    static hash co-locates the warm book with it, which an elastic
+    planner migrates away). Seed-deterministic like every profile here
+    (same stream for the same arguments — asserted in
+    tests/test_workload.py)."""
+    if num_symbols < 2:
+        raise ValueError("zipf-hot needs >= 2 symbols (hot + cold set)")
+    gen = WorkloadGen(num_accounts, num_symbols, seed=seed, validate=True,
+                      payout_opcode_bug=False)
+    msgs: List[OrderMsg] = []
+    for aid in range(num_accounts):
+        msgs.append(gen.create_account(aid))
+        msgs.append(gen.create_transfer(aid, deposit))
+    for sid in range(num_symbols):
+        msgs.append(gen.create_symbol(sid))
+    cold = num_symbols - 1
+    weights = [1.0 / (r + 1) ** zipf_a for r in range(cold)]
+    total = sum(weights)
+    cdf = []
+    acc = 0.0
+    for w in weights:
+        acc += w / total
+        cdf.append(acc)
+    for _ in range(num_events):
+        if gen.rng.random() < hot_frac:
+            sid = 0
+        else:
+            sid = 1 + bisect.bisect_left(cdf, gen.rng.random())
+        aid = gen._uniform(num_accounts)
+        e = gen._uniform(1000)
+        if e < 450:
+            msgs.append(gen.create_buy(aid, sid, gen._normal_param(50, 10),
+                                       gen._normal_param(50, 10)))
+        elif e < 900:
+            msgs.append(gen.create_sell(aid, sid, gen._normal_param(50, 10),
+                                        gen._normal_param(50, 10)))
+        else:
+            msgs.append(gen.create_cancel())
+    return msgs
+
+
+def payout_storm_stream(num_events: int, num_symbols: int,
+                        num_accounts: int, seed: int = 0,
+                        storms: int = 3,
+                        deposit: int = 10_000_000) -> List[OrderMsg]:
+    """Mass-settlement burst profile: steady Zipf trading punctuated by
+    `storms` evenly-spaced bursts in which EVERY symbol is paid out
+    (real PAYOUT opcode) and immediately re-ADDed. Each payout is a
+    barrier window in the mesh planner, so the profile stresses the
+    flush/rebind path and collapses then rebuilds every book at once.
+    Seed-deterministic."""
+    if storms < 1:
+        raise ValueError("payout-storm needs storms >= 1")
+    gen = WorkloadGen(num_accounts, num_symbols, seed=seed, validate=True,
+                      payout_opcode_bug=False)
+    msgs: List[OrderMsg] = []
+    for aid in range(num_accounts):
+        msgs.append(gen.create_account(aid))
+        msgs.append(gen.create_transfer(aid, deposit))
+    for sid in range(num_symbols):
+        msgs.append(gen.create_symbol(sid))
+    weights = [1.0 / (r + 1) ** 1.2 for r in range(num_symbols)]
+    total = sum(weights)
+    cdf = []
+    acc = 0.0
+    for w in weights:
+        acc += w / total
+        cdf.append(acc)
+    storm_at = {max(1, (i + 1) * num_events // (storms + 1))
+                for i in range(storms)}
+    for k in range(num_events):
+        if k in storm_at:
+            for sid in range(num_symbols):
+                msgs.append(gen.create_payout(sid,
+                                              gen.rng.random() < 0.5))
+                msgs.append(gen.create_symbol(sid))
+            continue
+        sid = bisect.bisect_left(cdf, gen.rng.random())
+        aid = gen._uniform(num_accounts)
+        e = gen._uniform(1000)
+        if e < 450:
+            msgs.append(gen.create_buy(aid, sid, gen._normal_param(50, 10),
+                                       gen._normal_param(50, 10)))
+        elif e < 900:
+            msgs.append(gen.create_sell(aid, sid, gen._normal_param(50, 10),
+                                        gen._normal_param(50, 10)))
+        else:
+            msgs.append(gen.create_cancel())
+    return msgs
+
+
 def cancel_heavy_stream(num_events: int, num_symbols: int, num_accounts: int,
                         seed: int = 0, cancel_ratio: float = 0.8,
                         deposit: int = 10_000_000) -> List[OrderMsg]:

@@ -115,6 +115,77 @@ def test_render_shows_stages_slo_and_supervisor():
     assert render(build_view(collect(None, None, None)))
 
 
+def test_render_shows_shard_rows():
+    """Per-shard straggler attribution (SeqMeshSession gauges): the
+    shard section appears iff shard_count is present, with occupancy
+    and the device_shard{N} quantiles per row."""
+    lats = {"device_shard0": {"count": 90, "sum_s": 0.4, "p50_ms": 3.0,
+                              "p90_ms": 5.0, "p99_ms": 6.0,
+                              "p999_ms": 6.5},
+            "device_shard1": {"count": 30, "sum_s": 0.1, "p50_ms": 1.0,
+                              "p90_ms": 1.5, "p99_ms": 2.0,
+                              "p999_ms": 2.2}}
+    node = _node(records=120,
+                 gauges={"shard_count": 2, "shard_imbalance": 1.5,
+                         "shard0_occupancy": 90,
+                         "shard1_occupancy": 30})
+    node["metrics"]["counters"].update(
+        {"shard_migrations_total": 3, "shard_rebalances_total": 1})
+    node["metrics"]["latencies"] = lats
+    view = build_view({"t": 1.0, "leader": node, "standby": _node(),
+                       "supervisor": None})
+    text = "\n".join(render(view))
+    assert "shards=2" in text
+    assert "imbalance=1.500" in text
+    assert "migrations=3" in text and "rebalances=1" in text
+    assert "occupancy" in text
+    # one row per shard: occupancy gauge + p50/p99 from the summary
+    row0 = next(ln for ln in text.splitlines()
+                if ln.strip().startswith("0 "))
+    assert "90" in row0 and "3.000" in row0 and "6.000" in row0
+    row1 = next(ln for ln in text.splitlines()
+                if ln.strip().startswith("1 "))
+    assert "30" in row1 and "2.000" in row1
+    # without the gauge the section stays hidden
+    plain = "\n".join(render(build_view(
+        {"t": 1.0, "leader": _node(records=1), "standby": _node(),
+         "supervisor": None})))
+    assert "shards=" not in plain
+
+
+def test_main_once_plain_frame_with_shards(tmp_path, capsys):
+    """--once over a heartbeat file carrying the mesh session's shard
+    gauges prints the shard rows in the plain frame."""
+    hb = str(tmp_path / "serve.health")
+    with open(hb, "w") as f:
+        json.dump({"role": "leader", "offset": 5, "epoch": 1,
+                   "degraded": None,
+                   "metrics": {
+                       "counters": {"service_records": 5,
+                                    "shard_migrations_total": 2,
+                                    "shard_rebalances_total": 1},
+                       "gauges": {"shard_count": 2,
+                                  "shard_imbalance": 1.18,
+                                  "shard0_occupancy": 40,
+                                  "shard1_occupancy": 60},
+                       "latencies": {
+                           "device_shard0": {"count": 40, "sum_s": 0.1,
+                                             "p50_ms": 2.0,
+                                             "p90_ms": 3.0,
+                                             "p99_ms": 4.0,
+                                             "p999_ms": 4.4},
+                           "device_shard1": {"count": 60, "sum_s": 0.2,
+                                             "p50_ms": 2.5,
+                                             "p90_ms": 3.5,
+                                             "p99_ms": 4.5,
+                                             "p999_ms": 5.0}}}}, f)
+    rc = main(["--leader", hb, "--once", "--no-rate-sample"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "shards=2" in out and "imbalance=1.180" in out
+    assert "migrations=2" in out
+
+
 def test_main_requires_a_source():
     with pytest.raises(SystemExit):
         main(["--once"])

@@ -8,7 +8,7 @@ import collections
 from kme_tpu import opcodes as op
 from kme_tpu.oracle import OracleEngine
 from kme_tpu.workload import WorkloadGen, cancel_heavy_stream, harness_stream, \
-    zipf_symbol_stream
+    payout_storm_stream, zipf_hot_stream, zipf_symbol_stream
 
 
 def test_deterministic_under_seed():
@@ -79,3 +79,54 @@ def test_scale_streams_shape():
     cancels = sum(1 for m in ch if m.action == op.CANCEL)
     # every cancel consumes one prior submit: steady state caps near 50%
     assert cancels > 0.45 * 2_000
+
+
+def test_zipf_hot_deterministic_and_skewed():
+    a = zipf_hot_stream(3_000, num_symbols=8, num_accounts=32, seed=9)
+    b = zipf_hot_stream(3_000, num_symbols=8, num_accounts=32, seed=9)
+    assert a == b
+    assert a != zipf_hot_stream(3_000, num_symbols=8, num_accounts=32,
+                                seed=10)
+    # symbol 0 dominates (hot_frac=0.7 of events), but the cold set is
+    # ZIPF, not uniform: the second-ranked book must be distinctly warm
+    # (that co-location is what defeats static `lane % shards` placement)
+    sub = collections.Counter(
+        m.sid for m in a if m.action in (op.BUY, op.SELL))
+    total = sum(sub.values())
+    assert sub[0] / total > 0.6
+    assert sub[1] > 1.5 * sub[4]
+    # valid domain end to end (the mesh parity tests feed this raw)
+    for m in a:
+        if m.action in (op.BUY, op.SELL):
+            assert 0 <= m.price <= 125 and m.size >= 1
+
+
+def test_payout_storm_deterministic_with_bursts():
+    a = payout_storm_stream(2_000, num_symbols=8, num_accounts=32,
+                            seed=4, storms=3)
+    assert a == payout_storm_stream(2_000, num_symbols=8,
+                                    num_accounts=32, seed=4, storms=3)
+    payouts = [i for i, m in enumerate(a) if m.action == op.PAYOUT]
+    # every storm settles EVERY symbol (real PAYOUT opcode, Q5 fixed)
+    assert len(payouts) == 3 * 8
+    # bursts are contiguous: each storm's 8 payouts interleave only
+    # with their re-ADDs (payout positions step by 2 within a burst)
+    for s in range(3):
+        burst = payouts[s * 8:(s + 1) * 8]
+        assert burst[-1] - burst[0] == 2 * 7
+    # each payout is immediately followed by the symbol's re-ADD
+    for i in payouts:
+        assert a[i + 1].action == op.ADD_SYMBOL
+        assert a[i + 1].sid == abs(a[i].sid)
+
+
+def test_adversarial_streams_survive_oracle():
+    e = OracleEngine("fixed")
+    for m in zipf_hot_stream(1_500, num_symbols=8, num_accounts=24,
+                             seed=2):
+        e.process(m)
+    e2 = OracleEngine("fixed")
+    for m in payout_storm_stream(1_500, num_symbols=8,
+                                 num_accounts=24, seed=2):
+        e2.process(m)
+    assert all(b >= 0 for b in e2.balances.values())
