@@ -55,6 +55,9 @@ HOT_SCOPES: Dict[str, Set[str]] = {
     # (_migrate/_maybe_rebalance) legitimately sync the state pytree
     # and are NOT listed, like the collect-side functions above
     "kme_tpu/parallel/seqmesh.py": {"plan_windows", "plan_rebalance"},
+    # the front door's merge loop sits on the serving path of EVERY
+    # group's consumer — a blocking call here stalls the global feed
+    "kme_tpu/bridge/front.py": {"merge_records", "merge_streams"},
 }
 
 # Replay scopes: functions whose outputs must be bit-identical when a
@@ -68,7 +71,18 @@ REPLAY_SCOPES: Dict[str, Set[str]] = {
         "batch_events", "canonical_lines", "iter_events",
         "read_events"},
     "kme_tpu/bridge/broker.py": {"_load_topic"},
-    "kme_tpu/bridge/service.py": {"_init_exactly_once", "_try_resume"},
+    "kme_tpu/bridge/service.py": {"_init_exactly_once", "_try_resume",
+                                  # cross-shard transfer routing: the
+                                  # MatchOut/Xfer split and the stamp
+                                  # assignment must regenerate
+                                  # identically on crash-replay
+                                  "_produce_out", "_produce_xfer"},
+    # the split IS the transfer regeneration path: a crash-replay
+    # re-runs route_line over the MatchIn prefix and must emit the
+    # byte-identical injected legs (same grants, same xids)
+    "kme_tpu/bridge/front.py": {"route_line", "split",
+                                "make_internal_transfer",
+                                "make_internal_create"},
     "kme_tpu/runtime/checkpoint.py": {
         "load_session", "load_seq_session", "load_native",
         "load_oracle", "snapshot_extra", "oldest_retained_offset"},

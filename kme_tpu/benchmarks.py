@@ -1187,13 +1187,164 @@ def bench_shards(events: int = 4000, symbols: int = 8,
     }
 
 
+def bench_groups(events: int = 20_000, symbols: int = 1024,
+                 accounts: int = 256, seed: int = 0,
+                 workload: str = "zipf", cross_frac: float = 0.5,
+                 group_counts=(1, 2, 4), slots: int = 128,
+                 max_fills: int = 16, prefund: int = 8,
+                 reps: int = 3) -> dict:
+    """Multi-leader scale-out suite (`--suite groups`, ISSUE 9): the
+    stream is split by the front door (bridge/front.py — rendezvous
+    symbol routing + chunked reserve→settle transfer injection) and
+    each group's substream runs through its own fresh engine. In the
+    deployed topology the N groups are N separate leader HOSTS, so the
+    deployment's throughput is bounded by its critical path — the
+    slowest group. The bench models exactly that: per-group walls are
+    measured SERIALLY (best of `reps`, after a process-level warmup
+    run) and accepted-orders/s = accepted / max(per-group wall). A CI
+    box with one core measures the same thing a multi-host deployment
+    would, without pretending threads on one core are machines. At
+    every group count the merged MatchOut is byte-compared against the
+    single-leader oracle partitioned by the same router
+    (front.verify_groups: THE COMPAT.md global-order convention).
+
+    Deterministic seed-derived metrics (transfer fraction, shortfalls,
+    parity) are the gated surface — wall-clock accepted-orders/s is
+    reported per count (the ≥ 2x acceptance check at the top group
+    count) but deliberately NOT under a GATED_METRICS name, same
+    policy as bench_shards."""
+    from kme_tpu.bridge import front
+    from kme_tpu.native.oracle import NativeOracleEngine, \
+        native_available
+    from kme_tpu.oracle import OracleEngine
+    from kme_tpu.wire import dumps_order, parse_order
+    from kme_tpu.workload import cross_account_stream, \
+        zipf_symbol_stream
+
+    top = max(group_counts)
+    if workload == "cross-account":
+        msgs = cross_account_stream(events, symbols, accounts, top,
+                                    seed=seed, cross_frac=cross_frac)
+    else:
+        msgs = zipf_symbol_stream(events, num_symbols=symbols,
+                                  num_accounts=accounts, seed=seed)
+    lines = [dumps_order(m) for m in msgs]
+    native = native_available()
+
+    def make_engine():
+        if native:
+            return NativeOracleEngine("fixed", book_slots=slots,
+                                      max_fills=max_fills)
+        return OracleEngine("fixed", book_slots=slots,
+                            max_fills=max_fills)
+
+    def run_engine(eng, parsed):
+        if native:
+            out = eng.process_wire(parsed)
+            return [ln for per_msg in out for ln in per_msg]
+        return [r.wire() for m in parsed for r in eng.process(m)]
+
+    # one throwaway run pays the process-level first-call costs
+    # (library load, allocator growth) so no group count eats them
+    run_engine(make_engine(),
+               [parse_order(ln) for ln in lines[:2000]])
+
+    per_counts = []
+    base_ops = None
+    accepted = None
+    for n in group_counts:
+        per_group, router = front.split_lines(lines, n,
+                                              prefund=prefund)
+        # parse is front-door work, identical at every group count —
+        # kept outside the timed engine region
+        parsed = [[parse_order(ln) for ln in sub] for sub in per_group]
+        outs = [None] * n
+        walls = []
+        for k in range(n):
+            best = None
+            for _ in range(max(1, reps)):
+                eng = make_engine()
+                t0 = time.perf_counter()
+                out = run_engine(eng, parsed[k])
+                w = time.perf_counter() - t0
+                best = w if best is None else min(best, w)
+                outs[k] = out
+            walls.append(best)
+        wall = max(walls)
+        rep = front.verify_groups(lines, outs, compat="fixed",
+                                  book_slots=slots,
+                                  max_fills=max_fills,
+                                  prefund=prefund)
+        if not rep["ok"]:
+            raise AssertionError(
+                f"groups={n}: merged MatchOut diverged from the "
+                f"single-leader oracle: {rep['mismatches'][:1]}")
+        if accepted is None:
+            # accepted orders are identical at every group count (the
+            # parity assertion above pins that) — count once
+            accepted = sum(
+                1 for g in outs for ln in g
+                if ln.startswith("OUT ")
+                and not front.is_internal_line(ln)
+                and any(f'"action":{a},' in ln for a in (2, 3, 5, 6)))
+        ops = accepted / wall
+        if base_ops is None:
+            base_ops = ops
+        per_counts.append({
+            "groups": n,
+            "group_walls_s": [round(w, 4) for w in walls],
+            "wall_s": round(wall, 4),
+            "accepted_per_sec": round(ops, 1),
+            "speedup": round(ops / base_ops, 2),
+            "substream_lines": [len(s) for s in per_group],
+            "transfers": router.counters["cross_shard_transfers_total"],
+            "shortfalls": router.counters["transfer_shortfall_total"],
+            "parity": "byte-exact"})
+    topc = per_counts[-1]
+    orders = sum(1 for m in msgs if m.action in (2, 3))
+    frac = round(topc["transfers"] / max(1, orders), 4)
+    detail = {
+        "suite": "groups", "workload": workload, "events": len(msgs),
+        "orders": orders, "group_counts": list(group_counts),
+        "prefund": prefund, "engine": "native" if native else "oracle",
+        "per_groups": per_counts,
+        "cross_shard_transfer_frac": frac,
+        "transfer_shortfalls": topc["shortfalls"],
+        "accepted_orders": accepted,
+        "speedup_top": topc["speedup"],
+        "note": "byte parity vs the partitioned single-leader oracle "
+                "asserted at every group count; accepted-orders/s is "
+                "wall-clock (ungated), transfer metrics deterministic "
+                "(gated)",
+        # engine identity doubles as the perfgate backend marker: a
+        # python-oracle run is not comparable to a native baseline, so
+        # a mismatch demotes the gate to advisory (same rule as
+        # TPU-vs-CPU elsewhere)
+        "backend": "native" if native else "oracle",
+    }
+    if topc["speedup"] < 2.0 and native:
+        detail["speedup_warning"] = (
+            f"groups={topc['groups']} accepted-orders/s only "
+            f"{topc['speedup']}x the single-leader run")
+        print(f"kme-bench: WARNING {detail['speedup_warning']}",
+              file=sys.stderr)
+    return {
+        "metric": "cross_shard_transfer_frac",
+        "value": frac,
+        "unit": "transfers/order",
+        "vs_baseline": round(
+            topc["accepted_per_sec"] / REFERENCE_BASELINE_OPS, 3),
+        "detail": detail,
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
     p = argparse.ArgumentParser(prog="kme-bench")
     p.add_argument("--suite", choices=("lanes", "parity", "native",
                                        "latency", "pipeline",
-                                       "shards"),
+                                       "shards", "groups"),
                    default="lanes")
     p.add_argument("--pipeline", type=int, default=2, metavar="N",
                    help="pipeline suite: in-flight batch window depth "
@@ -1218,7 +1369,7 @@ def main(argv=None) -> int:
                         "(0 = full-width)")
     p.add_argument("--workload",
                    choices=("zipf", "cancel", "zipf-hot",
-                            "payout-storm"),
+                            "payout-storm", "cross-account"),
                    default="zipf",
                    help="stream profile: Zipf-skewed, bursty cancel/"
                         "replace (BASELINE.md rows), one-symbol hot "
@@ -1238,6 +1389,16 @@ def main(argv=None) -> int:
                    help="micro-batch size (latency suite batches; parity "
                         "suite scan length)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cross-frac", type=float, default=0.5,
+                   help="groups suite, cross-account workload: "
+                        "fraction of orders forced onto non-home "
+                        "accounts (1.0 = the 100%% cross-shard worst "
+                        "case)")
+    p.add_argument("--prefund", type=int, default=8,
+                   help="groups suite: orders' worth of worst-case "
+                        "margin granted per cross-shard transfer pair "
+                        "(front.py chunked reserve->settle; 1 = exact "
+                        "per-order grants)")
     # None -> per-suite default: the native/parity suites judge java
     # (their reason to exist); the lanes/seq headline is fixed-mode
     # unless java is explicitly requested
@@ -1320,6 +1481,16 @@ def main(argv=None) -> int:
         rec = bench_pipeline(args.events or 40_960, args.symbols,
                              args.accounts, args.seed, args.zipf,
                              batch=args.batch, depth=args.pipeline)
+    elif args.suite == "groups":
+        rec = bench_groups(args.events or 20_000,
+                           symbols=args.symbols,
+                           accounts=min(args.accounts, 256),
+                           seed=args.seed,
+                           workload=args.workload,
+                           cross_frac=args.cross_frac,
+                           slots=args.slots or 128,
+                           max_fills=args.max_fills,
+                           prefund=args.prefund)
     elif args.suite == "shards":
         rec = bench_shards(args.events or 4000,
                            symbols=min(args.symbols, 8),

@@ -178,9 +178,12 @@ class _Producer(threading.Thread):
     errors never duplicate or drop input) and treats rej_overload as
     backpressure (sleep + retry the SAME record)."""
 
-    def __init__(self, host: str, port: int, lines: List[str]) -> None:
+    def __init__(self, host: str, port: int, lines: List[str],
+                 topic: str = TOPIC_IN, topics=None) -> None:
         super().__init__(daemon=True)
         self.host, self.port, self.lines = host, port, lines
+        self.topic = topic
+        self.topics = topics      # provision set (None = classic pair)
         self.sent = 0
         self.overload_retries = 0
         self.reconnects = 0
@@ -196,9 +199,9 @@ class _Producer(threading.Thread):
             try:
                 if client is None:
                     client = TcpBroker(self.host, self.port, timeout=10.0)
-                    provision(client)   # idempotent
-                    self.sent = client.end_offset(TOPIC_IN)
-                client.produce(TOPIC_IN, None, self.lines[self.sent])
+                    provision(client, topics=self.topics)   # idempotent
+                    self.sent = client.end_offset(self.topic)
+                client.produce(self.topic, None, self.lines[self.sent])
                 self.sent += 1
             except BrokerOverload:
                 self.overload_retries += 1
@@ -221,17 +224,17 @@ class _Producer(threading.Thread):
                 pass
 
 
-def read_matchout_records(log_dir: str) -> list:
-    """Post-mortem read of the durable MatchOut topic log (the broker
-    persists topics as JSONL under the checkpoint dir) as Records —
-    produce stamps included."""
+def read_matchout_records(log_dir: str, topic: str = TOPIC_OUT) -> list:
+    """Post-mortem read of a durable topic log (the broker persists
+    topics as JSONL under the checkpoint dir) as Records — produce
+    stamps included."""
     from kme_tpu.bridge.broker import BrokerError, InProcessBroker
 
     broker = InProcessBroker(persist_dir=log_dir)
     out: list = []
     try:
         while True:
-            recs = broker.fetch(TOPIC_OUT, len(out), 4096, timeout=0.0)
+            recs = broker.fetch(topic, len(out), 4096, timeout=0.0)
             if not recs:
                 return out
             out.extend(recs)
@@ -349,6 +352,317 @@ def _check_failover(ckpt_dir: str, log_dir: str, recoveries: list,
     return out
 
 
+def _busy_rate(samples: List[Tuple[float, int]],
+               t_lo: float, t_hi: float) -> Optional[float]:
+    """Offset-advance rate (msgs/s) of a heartbeat sample series inside
+    [t_lo, t_hi], restricted to the series' BUSY interval (before the
+    offset reached its final value — a group that already drained its
+    substream cannot be slowed down by anything). None = the window
+    holds no measurable busy samples."""
+    if len(samples) < 2:
+        return None
+    final = samples[-1][1]
+    busy_end = next((t for t, off in samples if off >= final),
+                    samples[-1][0])
+    lo, hi = max(t_lo, samples[0][0]), min(t_hi, busy_end)
+    win = [(t, off) for t, off in samples if lo <= t <= hi]
+    if len(win) < 2 or win[-1][0] <= win[0][0]:
+        return None
+    return (win[-1][1] - win[0][1]) / (win[-1][0] - win[0][0])
+
+
+def run_shard_failover(args, run_dir: str, report_path: str) -> int:
+    """--scenario shard-failover: the multi-leader drill (ISSUE 9). N
+    shard groups (bridge/front.py split, per-group namespaced topics,
+    per-group supervisors) serve concurrently; the busiest group's
+    leader runs with a hot standby and eats ONE seeded SIGKILL
+    mid-substream. Passes iff:
+
+    - the victim's standby promoted within --max-failover seconds;
+    - every SURVIVING group kept serving: zero restarts, clean exit,
+      and its busy-window throughput during the victim's outage dipped
+      < 10% vs its own full-run rate (measured from 10 Hz heartbeat
+      offset samples; a survivor that had already drained is exempt —
+      nothing was left to slow down);
+    - the merged MatchOut (all groups' durable MatchOut.gK + Xfer.gK
+      logs, consumer-deduped, re-zipped on the shared out_seq cursor)
+      is BYTE-EXACT vs the partitioned single-leader oracle
+      (front.verify_groups — the COMPAT.md convention);
+    - ZERO duplicate (epoch, out_seq) stamps in ANY durable log: the
+      victim's replayed overlap (MatchOut and regenerated transfer
+      legs alike) must have been suppressed by the idempotent-produce
+      watermark, never appended twice;
+    - a stale-epoch produce against the victim's MatchOut is fenced
+      post-mortem (no zombie leader can dirty the healed log).
+    """
+    from kme_tpu.bridge import front
+    from kme_tpu.bridge.broker import BrokerFenced, InProcessBroker
+    from kme_tpu.bridge.consume import DedupRing
+    from kme_tpu.bridge.provision import group_topics
+    from kme_tpu.wire import dumps_order
+    from kme_tpu.workload import cross_account_stream
+
+    groups = args.groups
+    # every group must carry real flow for the drill to mean anything:
+    # with few symbols the zipf head lands in one group and the others
+    # drain before the kill, leaving the dip check nothing to measure —
+    # a wide symbol universe balances the rendezvous placement
+    symbols = max(args.symbols, 64 * groups)
+    accounts = max(args.accounts, 8 * groups)
+    msgs = cross_account_stream(args.events, symbols, accounts, groups,
+                                seed=args.seed,
+                                cross_frac=args.cross_frac)
+    lines = [dumps_order(m) for m in msgs]
+    per_group, router = front.split_lines(lines, groups,
+                                          prefund=args.prefund)
+    sizes = [len(s) for s in per_group]
+    if min(sizes) == 0:
+        print(f"kme-chaos: substream sizes {sizes} — empty group; "
+              f"raise --symbols", file=sys.stderr)
+        return 2
+    victim = max(range(groups), key=lambda k: sizes[k])
+    # land the kill while EVERY group is still mid-substream (the
+    # groups drain concurrently at similar rates, so half the smallest
+    # substream is mid-flight for all of them) — otherwise the
+    # survivors are already idle and the dip check has nothing to
+    # measure
+    kill_at = max(1, min(sizes) // 2)
+    schedule = f"seed={args.seed};serve.kill:at={kill_at}"
+    print(f"kme-chaos: scenario=shard-failover seed={args.seed} "
+          f"groups={groups} substreams={sizes} victim=g{victim} "
+          f"kill_at={kill_at}\nkme-chaos: run dir {run_dir}",
+          file=sys.stderr)
+
+    sups, producers, gdirs = [], [], []
+    t0 = time.time()
+    for k in range(groups):
+        gdir = os.path.join(run_dir, f"group{k}")
+        ckpt = os.path.join(gdir, "state")
+        os.makedirs(ckpt, exist_ok=True)
+        gdirs.append(gdir)
+        port = _free_port()
+        serve_args = ["--engine", args.engine, "--compat", "fixed",
+                      "--batch", str(args.batch),
+                      "--slots", str(args.slots),
+                      "--max-fills", str(args.max_fills),
+                      "--checkpoint-every", str(args.checkpoint_every),
+                      "--checkpoint-keep", str(args.checkpoint_keep),
+                      "--group", f"{k}/{groups}",
+                      "--listen", f"127.0.0.1:{port}",
+                      "--idle-exit", str(args.idle_exit),
+                      "--health-every", "0.1"]
+        sup_cmd = [sys.executable, "-m", "kme_tpu.cli", "supervise",
+                   "--checkpoint-dir", ckpt,
+                   "--stale-after", str(args.stale_after),
+                   "--stall-after", str(args.stall_after),
+                   "--max-restarts", str(args.max_restarts),
+                   "--grace", str(args.grace),
+                   "--backoff-base", "0.05", "--backoff-cap", "0.5"]
+        if k == victim:
+            sup_cmd += ["--standby", "--poll", "0.1"]
+        sup_cmd += ["--"] + serve_args
+        env = dict(os.environ)
+        env.pop("KME_FAULTS", None)       # survivors run fault-free
+        env.pop("KME_FAULTS_STATE", None)
+        if k == victim:
+            env["KME_FAULTS"] = schedule
+            env["KME_FAULTS_STATE"] = os.path.join(gdir, "fault-state")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        sups.append(subprocess.Popen(sup_cmd, env=env))
+        prod = _Producer("127.0.0.1", port, per_group[k],
+                         topic=group_topics(k)[0],
+                         topics=group_topics(k))
+        prod.start()
+        producers.append(prod)
+
+    # 10 Hz heartbeat sampling: (wall time, input offset) per group —
+    # the survivors' liveness evidence during the victim's outage
+    samples: dict = {k: [] for k in range(groups)}
+    stop = threading.Event()
+
+    def monitor() -> None:
+        while not stop.wait(0.1):
+            for k in range(groups):
+                try:
+                    with open(os.path.join(gdirs[k], "state",
+                                           "serve.health")) as f:
+                        hb = json.load(f)
+                    samples[k].append((time.time(),
+                                       int(hb.get("offset", 0))))
+                except (OSError, ValueError, TypeError):
+                    pass
+
+    mon = threading.Thread(target=monitor, daemon=True)
+    mon.start()
+
+    rcs: List[Optional[int]] = [None] * groups
+    deadline = t0 + args.timeout
+    while time.time() < deadline:
+        rcs = [s.poll() for s in sups]
+        if all(rc is not None for rc in rcs):
+            break
+        time.sleep(0.25)
+    for s in sups:
+        if s.poll() is None:
+            print("kme-chaos: TIMEOUT; killing a supervisor",
+                  file=sys.stderr)
+            s.kill()
+            s.wait()
+    rcs = [s.returncode for s in sups]
+    stop.set()
+    mon.join(timeout=2.0)
+    for prod in producers:
+        prod.stop.set()
+        prod.join(timeout=10.0)
+    elapsed = time.time() - t0
+
+    failures: List[str] = []
+    for k in range(groups):
+        if rcs[k] != 0:
+            failures.append(f"group {k} supervisor exited rc={rcs[k]}")
+        if producers[k].sent < sizes[k]:
+            failures.append(f"group {k} producer delivered "
+                            f"{producers[k].sent} of {sizes[k]}")
+
+    # victim: promotion happened, and within the bound
+    sup_states = []
+    for k in range(groups):
+        st = {}
+        try:
+            with open(os.path.join(gdirs[k], "state",
+                                   "supervisor.json")) as f:
+                st = json.load(f)
+        except (OSError, ValueError):
+            pass
+        sup_states.append(st)
+    promoted = [r for r in sup_states[victim].get("recoveries", [])
+                if r.get("promoted")]
+    fo = [r["failover_seconds"] for r in promoted
+          if r.get("failover_seconds") is not None]
+    if not promoted:
+        failures.append("victim group never promoted its standby")
+    elif fo and max(fo) > args.max_failover:
+        failures.append(f"failover took {max(fo):.2f}s "
+                        f"(bound {args.max_failover}s)")
+
+    # survivors: no restarts, and the throughput dip during the
+    # victim's outage window stays under 10%
+    outage = None
+    if promoted and promoted[0].get("detected_at") is not None:
+        det = float(promoted[0]["detected_at"])
+        outage = (det, det + float(promoted[0].get("recovered_in", 0)))
+    dips: dict = {}
+    for k in range(groups):
+        if k == victim:
+            continue
+        restarts = int(sup_states[k].get("restarts_total", 0))
+        if restarts:
+            failures.append(f"surviving group {k} restarted "
+                            f"{restarts}x during the drill")
+        full = _busy_rate(samples[k], 0.0, float("inf"))
+        win = (_busy_rate(samples[k], *outage)
+               if outage is not None else None)
+        if full and win is not None:
+            dip = max(0.0, 1.0 - win / full)
+            dips[f"g{k}"] = round(dip, 4)
+            if dip >= 0.10:
+                failures.append(f"surviving group {k} throughput "
+                                f"dipped {dip:.0%} during failover "
+                                f"(bound 10%)")
+        else:
+            # drained before the outage (or the window was too short
+            # to hold two 10 Hz samples): nothing left to slow down
+            dips[f"g{k}"] = None
+
+    # durable logs: dedup per topic (ZERO duplicate stamps anywhere),
+    # then re-zip each group's MatchOut + Xfer on the shared out_seq
+    # cursor and verify the merged stream against the oracle
+    dup_stamps: dict = {}
+    actual: List[List[str]] = []
+    for k in range(groups):
+        log_dir = os.path.join(gdirs[k], "state", "broker-log")
+        merged = []
+        for topic in (group_topics(k)[1], group_topics(k)[2]):
+            recs = read_matchout_records(log_dir, topic=topic)
+            ring = DedupRing()
+            keep = [r for r in recs if not ring.is_dup(r.epoch,
+                                                       r.out_seq)]
+            dup_stamps[topic] = ring.suppressed
+            if ring.suppressed:
+                failures.append(f"{ring.suppressed} duplicate "
+                                f"(epoch,out_seq) stamp(s) in the "
+                                f"durable {topic} log")
+            merged.extend(keep)
+        merged.sort(key=lambda r: (r.out_seq
+                                   if r.out_seq is not None else -1))
+        actual.append([f"{r.key} {r.value}" for r in merged])
+    verify = front.verify_groups(lines, actual, compat="fixed",
+                                 book_slots=args.slots,
+                                 max_fills=args.max_fills,
+                                 prefund=args.prefund)
+    if not verify["ok"]:
+        failures.append(f"merged stream diverged from the single-"
+                        f"leader oracle: {verify['mismatches'][:1]}")
+
+    # zombie fence: a stale-epoch produce against the victim's healed
+    # MatchOut log must be rejected before anything is appended
+    probe = InProcessBroker(persist_dir=os.path.join(
+        gdirs[victim], "state", "broker-log"))
+    stale_fenced = False
+    try:
+        try:
+            probe.produce(group_topics(victim)[1], "OUT",
+                          "stale-epoch-probe", epoch=1, out_seq=10 ** 9)
+            failures.append("a stale-epoch produce against the "
+                            "victim's MatchOut was NOT fenced")
+        except BrokerFenced:
+            stale_fenced = True
+    finally:
+        if hasattr(probe, "close"):
+            probe.close()
+
+    report = {
+        "ok": not failures,
+        "failures": failures,
+        "scenario": "shard-failover",
+        "seed": args.seed,
+        "events": len(msgs),
+        "groups": groups,
+        "victim": victim,
+        "substreams": sizes,
+        "schedule": schedule,
+        "elapsed_seconds": round(elapsed, 3),
+        "promotions": len(promoted),
+        "failover_seconds": fo,
+        "survivor_dips": dips,
+        "outage_window_s": (round(outage[1] - outage[0], 3)
+                            if outage else None),
+        "duplicate_stamps": dup_stamps,
+        "cross_shard_transfers":
+            router.counters["cross_shard_transfers_total"],
+        "stale_epoch_fenced": stale_fenced,
+        "verify": dict(verify,
+                       mismatches=verify.get("mismatches", [])[:3]),
+        "supervisors": sup_states,
+        "run_dir": run_dir,
+    }
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1)
+    status = "OK" if report["ok"] else "FAILED"
+    print(f"kme-chaos: {status} — shard-failover groups={groups} "
+          f"victim=g{victim} promotions={len(promoted)} "
+          f"failover_seconds={fo} dips={dips} "
+          f"dup_stamps={sum(dup_stamps.values())} "
+          f"stale_epoch_fenced={stale_fenced} parity="
+          f"{'byte-exact' if verify['ok'] else 'DIVERGED'} "
+          f"elapsed={elapsed:.1f}s", file=sys.stderr)
+    for fail in failures:
+        print(f"kme-chaos: FAIL: {fail}", file=sys.stderr)
+    print(f"kme-chaos: report written to {report_path}",
+          file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
 def _fault_fires(state_dir: str) -> dict:
     fires = {}
     try:
@@ -368,7 +682,8 @@ def main(argv=None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("--seed", type=int, default=0,
                    help="seeds the workload AND every fault rule")
-    p.add_argument("--scenario", choices=("default", "failover"),
+    p.add_argument("--scenario", choices=("default", "failover",
+                                          "shard-failover"),
                    default="default",
                    help="default = the at-least-once recovery gauntlet "
                         "(every fault class, verify_stream); failover "
@@ -378,7 +693,25 @@ def main(argv=None) -> int:
                         "higher epoch within --max-failover seconds, "
                         "the old epoch to be fenced, and the deduped "
                         "MatchOut stream to be byte-exact with ZERO "
-                        "visible duplicates")
+                        "visible duplicates; shard-failover = the "
+                        "multi-leader drill: --groups shard groups "
+                        "serve concurrently, the busiest group's "
+                        "leader is SIGKILLed mid-substream, survivors "
+                        "must not dip >=10%, the standby must promote "
+                        "within --max-failover, the merged stream "
+                        "must be byte-exact and no durable log may "
+                        "hold a duplicate (epoch,out_seq) stamp")
+    p.add_argument("--groups", type=int, default=2,
+                   help="shard-failover scenario: number of shard "
+                        "groups (leader pairs)")
+    p.add_argument("--prefund", type=int, default=8,
+                   help="shard-failover scenario: chunked reserve "
+                        "grant size for cross-shard transfers "
+                        "(kme-front --prefund)")
+    p.add_argument("--cross-frac", type=float, default=0.5,
+                   help="shard-failover scenario: fraction of orders "
+                        "placed from non-home accounts (the "
+                        "cross-account workload profile)")
     p.add_argument("--max-failover", type=float, default=2.0,
                    help="failover scenario: max seconds from failure "
                         "detection to the promoted replica serving")
@@ -437,6 +770,10 @@ def main(argv=None) -> int:
 
         run_dir = tempfile.mkdtemp(prefix="kme-chaos-")
     os.makedirs(run_dir, exist_ok=True)
+    if args.scenario == "shard-failover":
+        report_path = args.report or os.path.join(
+            run_dir, "chaos-report.json")
+        return run_shard_failover(args, run_dir, report_path)
     ckpt_dir = os.path.join(run_dir, "state")
     state_dir = os.path.join(run_dir, "fault-state")
     os.makedirs(ckpt_dir, exist_ok=True)

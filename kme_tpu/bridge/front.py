@@ -1,0 +1,492 @@
+"""kme-front: the multi-leader front door (ROADMAP item 2).
+
+PAPER.md §7's scale-out shape: symbols are independent books with
+exactly one cross-symbol coupling (account balances), so the symbol
+space partitions across N leader/standby groups. This module is the
+seam between "one stream" and "N groups":
+
+- **Assignment** — rendezvous (highest-random-weight) hashing over a
+  shared splitmix64 mixer maps `abs(sid) -> group` (orders, symbol
+  lifecycle) and `aid -> home group` (balance custody). The C++
+  columnar twin is `kme_group_assign` (native/kme_router.cpp); the two
+  are bit-identical and pinned by tests/test_front.py.
+- **Split** — `GroupRouter.route_line` turns one MatchIn line into
+  per-group substream lines. The original line lands on exactly ONE
+  group; everything else it emits is *internal plumbing* marked with
+  `prev == XFER_MARK` (a value no organic stream carries — oids from
+  the reference harness are < 2^53):
+    * CREATE_BALANCE broadcasts marked copies to the non-home groups
+      (every group must know the account exists),
+    * a BUY/SELL whose account home differs from its symbol group gets
+      a reserve→settle TRANSFER pair injected ahead of it: a debit leg
+      (-grant) into the home group and a credit leg (+grant) into the
+      symbol group, `grant = min(worst_case_margin, shadow_home_cash)`.
+  Injected lines are ordinary durable MatchIn records in each group's
+  topic, so a crash-replay regenerates the identical transfers with
+  the identical `(epoch, out_seq)` stamps — the broker's idempotent
+  dedup layer (PR 4) is the cross-shard dedup key, exactly as KIP-98
+  uses it.
+- **Merge** — per-group MatchOut streams concatenate in group-id order
+  (≡ a stable sort on `(group, out_seq)`), with internal-marked lines
+  filtered. This is THE documented global-order convention (COMPAT.md
+  "Multi-leader global ordering").
+- **Parity** — `oracle_partition` computes the single-leader oracle's
+  output restricted to each group's assigned messages; `verify_groups`
+  byte-compares a real N-group run against it. Exact whenever accounts
+  stay funded at or above their worst-case open margin (all shipped
+  workloads); when the shadow ledger cannot cover a grant the front
+  counts a `transfer_shortfall_total` instead of guessing.
+
+The worst-case margin bound is exact, not heuristic: checkBalance
+reserves `(size + adj) * price` for buys and `(size - adj) * (100 -
+price)` for sells with `adj` netting against opposite holdings, so
+`size * price` (buy) / `size * (100 - price)` (sell) always dominates
+the reserve. The shadow ledger debits that bound for EVERY valid order
+(home or cross) and never credits fills back, so it is a conservative
+lower bound on the home group's real cash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from kme_tpu import opcodes as op
+from kme_tpu.wire import order_json, parse_order
+
+# distinct salts keep the symbol->group and account->group spaces
+# independently balanced (same key, different map)
+SALT_SYMBOL = 0x53594D42    # "SYMB"
+SALT_ACCOUNT = 0x41434354   # "ACCT"
+
+# internal-line marker: rides the POJO's pass-through `prev` pointer
+# field, which the engine echoes unmodified for TRANSFER and
+# CREATE_BALANCE (no book interaction ever mutates them). Outside the
+# organic oid range (reference harness oids are < 2^53), so no stream
+# the reference can produce collides with it.
+XFER_MARK = 0x4B4D452D46524E54   # "KME-FRNT"
+
+_MASK = (1 << 64) - 1
+_INT64_MIN = -(1 << 63)
+_MARK_SUB = f'"prev":{XFER_MARK}'
+
+
+def _mix64(z: int) -> int:
+    """splitmix64 finalizer — bit-identical twin of mix64 in
+    native/kme_router.cpp (see the warning there: assignment is part of
+    the durable stream split, the two must never drift)."""
+    z = (z + 0x9E3779B97F4A7C15) & _MASK
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK
+    return z ^ (z >> 31)
+
+
+def group_of(key: int, ngroups: int, salt: int) -> int:
+    """Rendezvous choice: argmax over per-(key, group) scores, ties to
+    the smaller group id (C++ uses strict `>` replacement)."""
+    if ngroups <= 1:
+        return 0
+    key &= _MASK
+    best, best_score = 0, -1
+    for g in range(ngroups):
+        score = _mix64(key ^ _mix64((salt + g) & _MASK))
+        if score > best_score:
+            best, best_score = g, score
+    return best
+
+
+def assign_groups(keys, ngroups: int, salt: int):
+    """Columnar assignment over an int64 array: the native pass when
+    the library is built, the vectorized numpy twin otherwise. Returns
+    int32 group ids."""
+    import ctypes
+
+    import numpy as np
+
+    from kme_tpu.native import check_buffer, load_library
+
+    keys = np.ascontiguousarray(keys, np.int64)
+    out = np.zeros(len(keys), np.int32)
+    if ngroups <= 1 or not len(keys):
+        return out
+    lib = load_library()
+    if lib is not None:
+        check_buffer("keys", keys, np.int64, len(keys))
+        lib.kme_group_assign(
+            len(keys), keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ngroups, salt,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
+
+    def mix(z):
+        z = z + np.uint64(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+    with np.errstate(over="ignore"):
+        k = keys.view(np.uint64)
+        scores = np.stack([
+            mix(k ^ mix(np.uint64((salt + g) & _MASK)))
+            for g in range(ngroups)])
+    # np.argmax takes the FIRST max — same tie-break as the scalar/C++
+    return scores.argmax(axis=0).astype(np.int32)
+
+
+def symbol_key(sid: int) -> int:
+    """The symbol identity a payout shares with its book: abs(sid),
+    except INT64_MIN (un-negatable; the engines host-reject it, we just
+    need a deterministic bucket)."""
+    return sid if sid == _INT64_MIN or sid >= 0 else -sid
+
+
+def symbol_group(sid: int, ngroups: int) -> int:
+    return group_of(symbol_key(sid), ngroups, SALT_SYMBOL)
+
+
+def account_group(aid: int, ngroups: int) -> int:
+    return group_of(aid, ngroups, SALT_ACCOUNT)
+
+
+def make_internal_transfer(aid: int, amount: int, xid: int) -> str:
+    """One leg of a reserve→settle pair: an ordinary TRANSFER wire line
+    carrying the internal marker (prev) and the deterministic transfer
+    ordinal (next) for post-mortem attribution."""
+    return order_json(op.TRANSFER, 0, aid, 0, 0, amount,
+                      next=xid, prev=XFER_MARK)
+
+
+def make_internal_create(aid: int, xid: int) -> str:
+    return order_json(op.CREATE_BALANCE, 0, aid, 0, 0, 0,
+                      next=xid, prev=XFER_MARK)
+
+
+def is_internal_line(line: str) -> bool:
+    """True for front-injected plumbing — input lines AND the engine's
+    IN/OUT echoes of them (the marker rides into both)."""
+    return _MARK_SUB in line
+
+
+class GroupRouter:
+    """Stateful splitter: MatchIn lines -> per-group substream lines.
+
+    Every decision is a pure function of the input prefix (no clock, no
+    RNG), so re-running the split from offset 0 regenerates the
+    byte-identical substreams — which is what makes the injected
+    transfer legs replay-safe: they are durable MatchIn records in each
+    group's topic, regenerated identically by any crash-replay.
+    """
+
+    def __init__(self, ngroups: int, transfers: bool = True,
+                 prefund: int = 8) -> None:
+        self.n = max(1, int(ngroups))
+        self.transfers = transfers
+        # chunked reserve→settle: each transfer pair grants up to
+        # `prefund` orders' worth of worst-case margin, and the
+        # UNCONSUMED remainder is tracked per (account, group) so
+        # repeat cross-shard traffic rides the residual instead of
+        # paying a fresh pair per order (the dominant transfer-path
+        # cost — see bench_groups' transfer_frac). prefund=1 degrades
+        # to exact per-order grants.
+        self.prefund = max(1, int(prefund))
+        self.oid_group: Dict[int, int] = {}    # oid -> routed group
+        self.home: Dict[int, int] = {}         # aid -> home group
+        self.cash: Dict[int, int] = {}         # aid -> shadow home cash
+        self.reserve: Dict[Tuple[int, int], int] = {}  # (aid, g) -> margin
+        self.xid = 0                           # injected-line ordinal
+        self.counters = {
+            "cross_shard_transfers_total": 0,
+            "transfer_shortfall_total": 0,
+            "transfer_volume_total": 0,
+            "balance_broadcasts_total": 0,
+        }
+
+    def account_home(self, aid: int) -> int:
+        h = self.home.get(aid)
+        if h is None:
+            h = account_group(aid, self.n)
+            self.home[aid] = h
+        return h
+
+    def _margin_bound(self, msg) -> int:
+        """Worst-case reserve of a valid order (dominates checkBalance's
+        adj-netted reserve; see module docstring). 0 for orders fixed
+        mode rejects before the balance check."""
+        if not (0 <= msg.price < 126) or msg.size <= 0:
+            return 0
+        if msg.action == op.BUY:
+            return msg.size * msg.price
+        return msg.size * (100 - msg.price)
+
+    def route_line(self, line: str) -> List[Tuple[int, str]]:
+        """One input line -> [(group, line), ...] in substream order.
+        The original line appears exactly once; every other entry is an
+        internal-marked injection. Raises ValueError on malformed input
+        (callers own the strict/drop decision, like the service does)."""
+        msg = parse_order(line)
+        a, n = msg.action, self.n
+        if n <= 1:
+            return [(0, line)]
+        if a in (op.BUY, op.SELL):
+            g = symbol_group(msg.sid, n)
+            self.oid_group[msg.oid] = g
+            h = self.account_home(msg.aid)
+            out: List[Tuple[int, str]] = []
+            w = self._margin_bound(msg) if self.transfers else 0
+            if w > 0:
+                have = self.cash.get(msg.aid, 0)
+                if h != g:
+                    r = self.reserve.get((msg.aid, g), 0)
+                    if r >= w:
+                        # residual from an earlier chunked grant covers
+                        # this order outright — no legs injected
+                        self.reserve[(msg.aid, g)] = r - w
+                    else:
+                        need = w - r
+                        grant = min(have, need + (self.prefund - 1) * w)
+                        if grant < need:
+                            self.counters[
+                                "transfer_shortfall_total"] += 1
+                        self.reserve[(msg.aid, g)] = max(
+                            0, r + grant - w)
+                        if grant > 0:
+                            self.cash[msg.aid] = have - grant
+                            out.append((h, make_internal_transfer(
+                                msg.aid, -grant, self.xid)))
+                            out.append((g, make_internal_transfer(
+                                msg.aid, grant, self.xid + 1)))
+                            self.xid += 2
+                            self.counters[
+                                "cross_shard_transfers_total"] += 1
+                            self.counters[
+                                "transfer_volume_total"] += grant
+                else:
+                    # a home-group order consumes home cash directly —
+                    # debit the shadow too, or a later grant could
+                    # exceed what the home engine really holds
+                    self.cash[msg.aid] = have - min(w, have)
+            out.append((g, line))
+            return out
+        if a == op.CANCEL:
+            g = self.oid_group.get(msg.oid)
+            if g is None:
+                # unknown oid: the engine rejects it wherever it lands —
+                # pick the bucket its oid hashes to so duplicates and
+                # replays route identically
+                g = group_of(msg.oid, n, SALT_SYMBOL)
+            return [(g, line)]
+        if a == op.CREATE_BALANCE:
+            h = self.account_home(msg.aid)
+            self.cash.setdefault(msg.aid, 0)
+            out = []
+            for g in range(n):
+                if g == h:
+                    out.append((g, line))
+                else:
+                    out.append((g, make_internal_create(msg.aid,
+                                                        self.xid)))
+                    self.xid += 1
+                    self.counters["balance_broadcasts_total"] += 1
+            return out
+        if a == op.TRANSFER:
+            h = self.account_home(msg.aid)
+            # deposits raise the shadow; withdrawals lower it (clamped —
+            # the engine never lets a balance go negative)
+            self.cash[msg.aid] = max(
+                0, self.cash.get(msg.aid, 0) + msg.size)
+            return [(h, line)]
+        # symbol lifecycle (and unknown actions, which every engine
+        # rejects): bucket by symbol identity
+        return [(symbol_group(msg.sid, n), line)]
+
+    def split(self, lines: Iterable[str]) -> List[List[str]]:
+        """Whole-stream convenience: per-group substream line lists."""
+        per: List[List[str]] = [[] for _ in range(self.n)]
+        for line in lines:
+            for g, ln in self.route_line(line):
+                per[g].append(ln)
+        return per
+
+
+def split_lines(lines: Iterable[str], ngroups: int,
+                transfers: bool = True, prefund: int = 8):
+    """(per_group substreams, the router that built them)."""
+    router = GroupRouter(ngroups, transfers=transfers, prefund=prefund)
+    return router.split(lines), router
+
+
+def merge_records(records: Iterable[Tuple[int, int, str]]) -> List[str]:
+    """THE global-order convention (COMPAT.md): stable sort on
+    `(group, out_seq)`, internal-marked lines dropped. `records` may
+    arrive in any interleaving — per-group consumers race — and the
+    result is identical."""
+    recs = sorted(records, key=lambda r: (r[0], r[1]))
+    return [r[2] for r in recs if not is_internal_line(r[2])]
+
+
+def merge_streams(per_group: Sequence[Sequence[str]]) -> List[str]:
+    """Merge per-group MatchOut streams already in per-group order:
+    concatenation in group-id order ≡ merge_records with out_seq = the
+    line's index in its group stream."""
+    out: List[str] = []
+    for lines in per_group:
+        out.extend(ln for ln in lines if not is_internal_line(ln))
+    return out
+
+
+def oracle_partition(lines: Sequence[str], ngroups: int,
+                     compat: str = "fixed",
+                     book_slots: Optional[int] = None,
+                     max_fills: Optional[int] = None,
+                     transfers: bool = True, prefund: int = 8):
+    """Single-leader ground truth, partitioned by the front's own
+    assignment: per_group[g] is the single oracle's output stream
+    restricted to the messages the front routes to g. Returns
+    (per_group expected wire lines, the GroupRouter used). The injected
+    internal legs have no expected lines — their echoes are suppressed
+    on merge."""
+    from kme_tpu.oracle import OracleEngine
+
+    router = GroupRouter(ngroups, transfers=transfers, prefund=prefund)
+    eng = OracleEngine(compat, book_slots, max_fills)
+    per: List[List[str]] = [[] for _ in range(max(1, ngroups))]
+    for line in lines:
+        routed = router.route_line(line)
+        prim = [g for g, ln in routed if not is_internal_line(ln)]
+        assert len(prim) == 1, "input line carries the internal marker"
+        per[prim[0]].extend(
+            rec.wire() for rec in eng.process(parse_order(line)))
+    return per, router
+
+
+def verify_groups(lines: Sequence[str],
+                  actual_per_group: Sequence[Sequence[str]],
+                  compat: str = "fixed",
+                  book_slots: Optional[int] = None,
+                  max_fills: Optional[int] = None,
+                  prefund: int = 8) -> dict:
+    """Byte-compare an N-group run against the partitioned single-leader
+    oracle. `actual_per_group[g]` is group g's raw MatchOut lines
+    (internal echoes still present — filtered here). Returns a report;
+    report["ok"] is the parity verdict."""
+    ngroups = len(actual_per_group)
+    want, router = oracle_partition(lines, ngroups, compat=compat,
+                                    book_slots=book_slots,
+                                    max_fills=max_fills,
+                                    prefund=prefund)
+    report: dict = {"groups": ngroups, "ok": True, "mismatches": [],
+                    "counters": dict(router.counters)}
+    for g in range(ngroups):
+        got = [ln for ln in actual_per_group[g]
+               if not is_internal_line(ln)]
+        if got == want[g]:
+            continue
+        report["ok"] = False
+        n = min(len(got), len(want[g]))
+        div = next((i for i in range(n) if got[i] != want[g][i]), n)
+        report["mismatches"].append({
+            "group": g, "at": div, "got_lines": len(got),
+            "want_lines": len(want[g]),
+            "got": got[div] if div < len(got) else None,
+            "want": want[g][div] if div < len(want[g]) else None})
+    merged = merge_streams(actual_per_group)
+    report["merged_lines"] = len(merged)
+    report["expected_merged_lines"] = sum(len(w) for w in want)
+    return report
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def _read_lines(path: Optional[str]):
+    fh = sys.stdin if path in (None, "-") else open(path)
+    try:
+        return [ln.strip() for ln in fh if ln.strip()]
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kme-front",
+        description="multi-leader front door: split a MatchIn stream "
+                    "into per-group substreams (with cross-shard "
+                    "balance-transfer injection), merge per-group "
+                    "MatchOut streams into the canonical global feed, "
+                    "or verify an N-group run against the single-leader "
+                    "oracle")
+    p.add_argument("mode", choices=("split", "merge", "verify"))
+    p.add_argument("--groups", type=int, required=True, metavar="N")
+    p.add_argument("--input", default=None, metavar="PATH",
+                   help="order-JSONL input stream (default stdin; "
+                        "split and verify)")
+    p.add_argument("--out-dir", default=None, metavar="DIR",
+                   help="split: write group{K}.in substream files here")
+    p.add_argument("--in-dir", default=None, metavar="DIR",
+                   help="merge/verify: read group{K}.out per-group "
+                        "MatchOut line files from here")
+    p.add_argument("--no-transfers", action="store_true",
+                   help="split symbols only; skip balance-transfer "
+                        "injection (parity then requires every account "
+                        "to be funded in every group)")
+    p.add_argument("--compat", choices=("java", "fixed"),
+                   default="fixed", help="oracle compat for verify")
+    p.add_argument("--slots", type=int, default=None,
+                   help="capacity envelope for verify (match the "
+                        "serving engines' --slots)")
+    p.add_argument("--max-fills", type=int, default=None)
+    p.add_argument("--prefund", type=int, default=8,
+                   help="orders' worth of worst-case margin granted "
+                        "per reserve->settle transfer pair (residual "
+                        "tracked per account x group; 1 = exact "
+                        "per-order grants)")
+    args = p.parse_args(argv)
+    import json
+
+    n = args.groups
+    if n < 1:
+        p.error("--groups must be >= 1")
+    if args.mode == "split":
+        lines = _read_lines(args.input)
+        per, router = split_lines(lines, n,
+                                  transfers=not args.no_transfers,
+                                  prefund=args.prefund)
+        if args.out_dir is None:
+            p.error("split needs --out-dir")
+        os.makedirs(args.out_dir, exist_ok=True)
+        for g in range(n):
+            with open(os.path.join(args.out_dir,
+                                   f"group{g}.in"), "w") as f:
+                f.write("\n".join(per[g]) + ("\n" if per[g] else ""))
+        doc = {"groups": n, "input_lines": len(lines),
+               "per_group": [len(x) for x in per]}
+        doc.update(router.counters)
+        print(json.dumps(doc), file=sys.stderr)
+        return 0
+    if args.in_dir is None:
+        p.error(f"{args.mode} needs --in-dir")
+    per_out = []
+    for g in range(n):
+        path = os.path.join(args.in_dir, f"group{g}.out")
+        per_out.append(_read_lines(path) if os.path.exists(path) else [])
+    if args.mode == "merge":
+        for ln in merge_streams(per_out):
+            print(ln)
+        return 0
+    # verify
+    lines = _read_lines(args.input)
+    report = verify_groups(lines, per_out, compat=args.compat,
+                           book_slots=args.slots,
+                           max_fills=args.max_fills,
+                           prefund=args.prefund)
+    print(json.dumps(report, indent=2), file=sys.stderr)
+    print(f"kme-front: parity "
+          f"{'OK' if report['ok'] else 'DIVERGED'}", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

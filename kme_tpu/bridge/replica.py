@@ -146,7 +146,9 @@ class Replica:
                  poll: float = 0.2, health_every: float = 1.0,
                  max_messages: Optional[int] = None,
                  idle_exit: Optional[float] = None,
-                 metrics_port: Optional[int] = None) -> None:
+                 metrics_port: Optional[int] = None,
+                 group=None) -> None:
+        self.group = group
         self.checkpoint_dir = checkpoint_dir
         self.listen = listen
         self.max_lag = max_lag
@@ -162,7 +164,11 @@ class Replica:
         self.log_dir = os.path.join(checkpoint_dir, "broker-log")
         self.holdback = max(1, batch)   # stay one batch behind (docstring)
         self._ppid = os.getppid()   # orphan detection (follow loop)
-        self.follow = _FollowBroker(self.log_dir)
+        topic_in = TOPIC_IN
+        if group is not None and group[1] > 1:
+            # shard-group mode: follow the group's namespaced input log
+            topic_in = f"{TOPIC_IN}.g{group[0]}"
+        self.follow = _FollowBroker(self.log_dir, topic=topic_in)
         self.svc = MatchService(
             self.follow, engine=engine, compat=compat, batch=batch,
             symbols=symbols, accounts=accounts, slots=slots,
@@ -170,7 +176,7 @@ class Replica:
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
             checkpoint_keep=checkpoint_keep,
-            exactly_once=True, follower=True)
+            exactly_once=True, follower=True, group=group)
         self.metrics_server = None
         if metrics_port is not None:
             # the standby's own metrics surface (kme-top scrapes it
@@ -276,7 +282,7 @@ class Replica:
         The applied-offset .. dead-leader-output overlap replays through
         the broker's idempotent-produce watermark (see module
         docstring)."""
-        from kme_tpu.bridge.provision import provision
+        from kme_tpu.bridge.provision import group_topics, provision
         from kme_tpu.bridge.tcp import parse_addr, serve_broker
 
         svc = self.svc
@@ -284,7 +290,10 @@ class Replica:
             os.unlink(self.promote_file)
         broker = InProcessBroker(persist_dir=self.log_dir,
                                  max_lag=self.max_lag)
-        provision(broker)       # idempotent; logs already reloaded
+        provision(broker, topics=(group_topics(self.group[0])
+                                  if self.group is not None
+                                  and self.group[1] > 1 else None))
+        # ^ idempotent; logs already reloaded
         host, port = parse_addr(self.listen)
         deadline = time.monotonic() + 10.0
         while True:
@@ -378,6 +387,10 @@ def main(argv=None) -> int:
                    help="serve this standby's own /metrics + "
                         "/metrics.json (0 picks a free port); kme-top "
                         "scrapes it next to the leader's")
+    p.add_argument("--group", default=None, metavar="K/N",
+                   help="follow shard group K of N (namespaced "
+                        "MatchIn.gK log; promotion rebinds the group's "
+                        "own topics)")
     args, unknown = p.parse_known_args(argv)
     if unknown:
         # the supervisor forwards the leader's serve_args verbatim;
@@ -385,6 +398,15 @@ def main(argv=None) -> int:
         # to a follower and are ignored loudly rather than fatally
         print(f"kme-standby: ignoring serve-only flag(s): "
               f"{' '.join(unknown)}", file=sys.stderr)
+    group = None
+    if args.group is not None:
+        try:
+            gk, gn = (int(x) for x in args.group.split("/", 1))
+        except ValueError:
+            print(f"kme-standby: --group wants K/N, got {args.group!r}",
+                  file=sys.stderr)
+            return 2
+        group = (gk, gn)
     rep = Replica(args.checkpoint_dir, listen=args.listen,
                   engine=args.engine, compat=args.compat,
                   batch=args.batch, symbols=args.symbols,
@@ -400,7 +422,8 @@ def main(argv=None) -> int:
                   poll=args.poll, health_every=args.health_every,
                   max_messages=args.max_messages,
                   idle_exit=args.idle_exit,
-                  metrics_port=args.metrics_port)
+                  metrics_port=args.metrics_port,
+                  group=group)
     try:
         return rep.run()
     except BrokerFenced as e:

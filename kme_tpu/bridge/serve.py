@@ -152,6 +152,15 @@ def main(argv=None) -> int:
                         "outputs are visible (needs engine=seq, "
                         "compat=fixed and the native host runtime; "
                         "anything else serves serial with a note)")
+    p.add_argument("--group", default=None, metavar="K/N",
+                   help="serve shard group K of an N-group multi-leader "
+                        "topology (ISSUE 9): the service consumes "
+                        "MatchIn.gK, produces MatchOut.gK, and lands "
+                        "front-injected cross-shard transfer legs on "
+                        "the stamped Xfer.gK evidence topic; pair with "
+                        "a per-group --checkpoint-dir so the lease/"
+                        "journal/snapshot roots are disjoint (kme-"
+                        "supervise --groups N wires all of this)")
     p.add_argument("--annotate-rejects", action="store_true",
                    help="emit an ADDITIVE 'REJ'-keyed MatchOut record "
                         "naming each rejected order's rej_* reason "
@@ -162,9 +171,23 @@ def main(argv=None) -> int:
     import os
 
     from kme_tpu.bridge.broker import InProcessBroker
-    from kme_tpu.bridge.provision import provision
+    from kme_tpu.bridge.provision import group_topics, provision
     from kme_tpu.bridge.service import MatchService
     from kme_tpu.bridge.tcp import parse_addr, serve_broker
+
+    group = None
+    if args.group is not None:
+        try:
+            gk, gn = (int(x) for x in args.group.split("/", 1))
+        except ValueError:
+            print(f"kme-serve: --group wants K/N, got {args.group!r}",
+                  file=sys.stderr)
+            return 2
+        if not (0 <= gk < gn):
+            print(f"kme-serve: --group {gk}/{gn} out of range",
+                  file=sys.stderr)
+            return 2
+        group = (gk, gn)
 
     if args.kafka is not None:
         from kme_tpu.bridge.kafka import KafkaBroker
@@ -184,7 +207,9 @@ def main(argv=None) -> int:
         print(f"kme-serve: broker listening on {real_host}:{real_port}",
               file=sys.stderr)
     if args.auto_provision:
-        provision(broker)
+        provision(broker, topics=(group_topics(group[0])
+                                  if group is not None and group[1] > 1
+                                  else None))
     # exactly-once is the DEFAULT served contract once durability is on
     # (the reference shipped with it commented out, KProcessor.java:29);
     # --at-least-once opts back into the historical behavior. The Kafka
@@ -222,6 +247,7 @@ def main(argv=None) -> int:
                        annotate_rejects=args.annotate_rejects,
                        exactly_once=exactly_once,
                        pipeline=args.pipeline,
+                       group=group,
                        slo=(None if args.slo_p99_ms is None else {
                            "stage": args.slo_stage,
                            "p99_ms": args.slo_p99_ms,

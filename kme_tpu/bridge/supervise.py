@@ -85,6 +85,7 @@ class Supervisor:
                  stall_after: float = 300.0,
                  backoff_base: float = 0.25, backoff_cap: float = 10.0,
                  healthy_decay: float = 60.0, standby: bool = False,
+                 tag: str = "",
                  popen=None, clock=None, sleep=None, mtime=None,
                  rng=None) -> None:
         """serve_args: argv tail passed to `kme-serve` verbatim (the
@@ -106,6 +107,7 @@ class Supervisor:
                     f"appear in serve_args (the child must write the "
                     f"heartbeat/checkpoints the supervisor watches)")
         self.checkpoint_dir = checkpoint_dir
+        self.tag = tag          # log prefix, e.g. "[g0]" in groups mode
         self.stale_after = stale_after
         self.max_restarts = max_restarts
         self.grace = grace
@@ -152,7 +154,7 @@ class Supervisor:
 
     def _say(self, msg: str) -> None:
         if self.echo:
-            print(f"kme-supervise: {msg}", file=sys.stderr)
+            print(f"kme-supervise{self.tag}: {msg}", file=sys.stderr)
 
     def _hb_age(self) -> float:
         try:
@@ -431,6 +433,60 @@ def supervise(serve_args, checkpoint_dir: str, stale_after: float = 10.0,
                       echo=echo, stall_after=stall_after, **kw).run()
 
 
+def supervise_groups(serve_args, state_root: str, groups: int,
+                     port_base: int = 9092, host: str = "127.0.0.1",
+                     echo: bool = True, **kw) -> int:
+    """Multi-leader scale-out (ISSUE 9): run `groups` independent
+    leader/standby pairs under ONE supervisor process. Group k gets its
+    own checkpoint root <state_root>/group{k} (lease, snapshots, broker
+    log, journal all disjoint), its own broker endpoint at
+    port_base + k, and `--group k/N` on its serve/standby children so
+    every durable broker topic is namespaced. Each pair has its OWN
+    Supervisor instance — backoff fingerprints, restart budgets and
+    promotion decisions never couple across groups, which is exactly
+    the failure-isolation property the shard-failover drill asserts.
+    Returns the max exit code across groups (0 = all healthy exits)."""
+    import threading
+
+    if groups < 1:
+        raise ValueError(f"--groups wants >= 1, got {groups}")
+    for a in serve_args:
+        flag = a.split("=", 1)[0]
+        if (flag.startswith("--") and len(flag) > 2
+                and any(r.startswith(flag)
+                        for r in ("--listen", "--group"))):
+            raise ValueError(
+                f"{flag} is managed per group by the supervisor in "
+                f"--groups mode and cannot appear in serve_args")
+    sups = []
+    for k in range(groups):
+        gdir = os.path.join(state_root, f"group{k}")
+        os.makedirs(gdir, exist_ok=True)
+        gargs = list(serve_args) + [
+            "--group", f"{k}/{groups}",
+            "--listen", f"{host}:{port_base + k}"]
+        sups.append(Supervisor(gargs, gdir, echo=echo,
+                               tag=f"[g{k}]", **kw))
+    if groups == 1:
+        return sups[0].run()
+    rcs = [0] * groups
+    threads = []
+    for k, sup in enumerate(sups):
+        def _run(k=k, sup=sup):
+            try:
+                rcs[k] = sup.run()
+            except ValueError as e:
+                print(f"kme-supervise[g{k}]: {e}", file=sys.stderr)
+                rcs[k] = 2
+        th = threading.Thread(target=_run, name=f"supervise-g{k}",
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    return max(rcs)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="kme-supervise", description=__doc__,
@@ -466,6 +522,19 @@ def main(argv=None) -> int:
     p.add_argument("--poll", type=float, default=0.5,
                    help="watch-loop poll interval (failure detection "
                         "latency bound)")
+    p.add_argument("--groups", type=int, default=1, metavar="N",
+                   help="multi-leader scale-out: run N independent "
+                        "leader(/standby) pairs, group k rooted at "
+                        "<checkpoint-dir>/group{k} with --group k/N "
+                        "and its own broker port (--port-base + k); "
+                        "backoff fingerprints and restart budgets "
+                        "never couple across groups")
+    p.add_argument("--port-base", type=int, default=9092,
+                   help="first group's broker port in --groups mode "
+                        "(group k listens on --port-base + k)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address for the per-group broker "
+                        "endpoints in --groups mode")
     p.add_argument("serve_args", nargs=argparse.REMAINDER,
                    help="arguments after '--' go to kme-serve verbatim")
     args = p.parse_args(argv)
@@ -473,16 +542,21 @@ def main(argv=None) -> int:
     if serve_args and serve_args[0] == "--":
         serve_args = serve_args[1:]
     os.makedirs(args.checkpoint_dir, exist_ok=True)
+    policy = dict(stale_after=args.stale_after,
+                  max_restarts=args.max_restarts, grace=args.grace,
+                  poll=args.poll,
+                  stall_after=args.stall_after,
+                  backoff_base=args.backoff_base,
+                  backoff_cap=args.backoff_cap,
+                  healthy_decay=args.healthy_decay,
+                  standby=args.standby)
     try:
-        return supervise(serve_args, args.checkpoint_dir,
-                         stale_after=args.stale_after,
-                         max_restarts=args.max_restarts, grace=args.grace,
-                         poll=args.poll,
-                         stall_after=args.stall_after,
-                         backoff_base=args.backoff_base,
-                         backoff_cap=args.backoff_cap,
-                         healthy_decay=args.healthy_decay,
-                         standby=args.standby)
+        if args.groups > 1:
+            return supervise_groups(serve_args, args.checkpoint_dir,
+                                    args.groups,
+                                    port_base=args.port_base,
+                                    host=args.host, **policy)
+        return supervise(serve_args, args.checkpoint_dir, **policy)
     except ValueError as e:
         print(f"kme-supervise: {e}", file=sys.stderr)
         return 2

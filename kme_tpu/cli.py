@@ -366,6 +366,17 @@ def top_main(argv=None) -> int:
     return _main(argv)
 
 
+def front_main(argv=None) -> int:
+    """Multi-leader front door: split MatchIn into per-group substreams
+    (cross-shard balance transfers injected), merge per-group MatchOut
+    streams into the canonical global feed, verify vs the oracle."""
+    try:
+        from kme_tpu.bridge.front import main as _main
+    except ImportError:
+        return _not_yet("the multi-leader front door")
+    return _main(argv)
+
+
 def chaos_main(argv=None) -> int:
     """Deterministic fault-injection runs (kme-supervise + KME_FAULTS)
     with byte-exact MatchOut verification against the oracle."""
@@ -388,7 +399,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m kme_tpu.cli")
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
-        "supervise", "standby", "trace", "chaos", "top", "lint"))
+        "supervise", "standby", "trace", "chaos", "top", "lint",
+        "front"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
@@ -397,7 +409,7 @@ def main(argv=None) -> int:
             "consume": consume_main, "provision": provision_main,
             "supervise": supervise_main, "standby": standby_main,
             "trace": trace_main, "chaos": chaos_main,
-            "top": top_main, "lint": lint_main,
+            "top": top_main, "lint": lint_main, "front": front_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

@@ -220,6 +220,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                       None),
         "kme_router_import_routes": ([c.c_void_p, c.c_int64, P64, P64],
                                      None),
+        # consistent-hash group assignment (kme_router.cpp, stateless)
+        "kme_group_assign": ([c.c_int64, P64, c.c_int32, c.c_int64,
+                              P32], None),
         # native wire reconstruction (kme_wire.cpp)
         "kme_recon_new": ([], c.c_void_p),
         "kme_recon_free": ([c.c_void_p], None),

@@ -65,9 +65,52 @@ struct Router {
   }
 };
 
+// splitmix64 finalizer — the shared 64-bit mixer of the group
+// assignment below and its Python twin (bridge/front.py _mix64). The
+// two MUST stay bit-identical: the front's split decision is part of
+// the durable stream (each group replays its own MatchIn), so an
+// assignment drift would re-home symbols across a version bump.
+inline uint64_t mix64(uint64_t z) {
+  z += 0x9E3779B97F4A7C15ull;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+// rendezvous (highest-random-weight) choice: every (key, group) pair
+// gets an independent score; the max wins. Adding a group moves only
+// the keys the new group wins — the consistent-hash property the
+// front door needs when N changes.
+inline int32_t group_of(uint64_t key, int32_t ngroups, uint64_t salt) {
+  int32_t best = 0;
+  uint64_t best_score = 0;
+  for (int32_t g = 0; g < ngroups; g++) {
+    uint64_t score = mix64(key ^ mix64(salt + (uint64_t)g));
+    if (g == 0 || score > best_score) {
+      best = g;
+      best_score = score;
+    }
+  }
+  return best;
+}
+
 }  // namespace
 
 extern "C" {
+
+// Columnar group assignment: out[i] = rendezvous group of key[i] among
+// ngroups, under `salt` (distinct salts keep the symbol->group and
+// account->group spaces independently balanced). Stateless and pure —
+// tens of ns/key, same cost profile as kme_router_route.
+void kme_group_assign(int64_t n, const int64_t* key, int32_t ngroups,
+                      int64_t salt, int32_t* out) {
+  if (ngroups <= 1) {
+    for (int64_t i = 0; i < n; i++) out[i] = 0;
+    return;
+  }
+  for (int64_t i = 0; i < n; i++)
+    out[i] = group_of((uint64_t)key[i], ngroups, (uint64_t)salt);
+}
 
 void* kme_router_new(int64_t lanes, int64_t accounts) {
   auto* r = new Router();

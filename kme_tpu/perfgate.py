@@ -57,6 +57,10 @@ GATED_METRICS = {
     # the skewed suite — scale-free like measured_overlap_frac, so it
     # gates tightly even on jittery shared runners
     "shard_imbalance": "down",
+    # multi-leader groups (ISSUE r09): transfer legs per order under
+    # the fixed-seed suite — fully deterministic (router + prefund
+    # policy, no wall-clock term), so it gates at zero noise
+    "cross_shard_transfer_frac": "down",
 }
 
 # reported-only: too noisy to gate on (documented flappers)

@@ -565,6 +565,21 @@ class SeqSession:
                     "route beyond-int64 streams through process_wire")
         with self.timer.phase("plan_s"):
             cols, host_rejects, stacked, cnts, K = self._plan(msgs)
+        with self.timer.phase("stage_s"):
+            # explicit async H2D staging: device_put enqueues the copy
+            # of batch N+1's input planes while the device still runs
+            # batch N's scan — the jit call below then consumes
+            # already-on-device buffers instead of paying a sync
+            # transfer at dispatch time. (State donation is NOT an
+            # option here: it clobbers the kernel's
+            # input_output_aliases — see build_seq_scan.)
+            import jax as _jax
+
+            stacked = _jax.device_put(stacked)
+        # advisory gauge (never perfgate-gated: pure wall time): the
+        # cumulative host cost of the async staging enqueues
+        self.telemetry.publish_gauges(
+            {"h2d_stage_s": round(self.phases.get("stage_s", 0.0), 6)})
         with self.timer.phase("dispatch_s"):
             # async enqueue: NO block_until_ready here — the device
             # runs this batch while the host plans/collects others

@@ -204,6 +204,31 @@ def render(view: dict, width: int = 78) -> list:
                 f"{_fmt(v.get('p50_ms'), 3):>11s} "
                 f"{_fmt(v.get('p99_ms'), 3):>11s}")
 
+    # multi-leader shard group (bridge/front.py scale-out): the
+    # leader's place in the group universe, its input lag, and the
+    # cross-shard transfer traffic with the reserve->settle RTT
+    ngroups = _gauge(lead, "group_count")
+    if ngroups and ngroups > 1:
+        gid = _gauge(lead, "group_id")
+        lag = (_gauge(lead, f"group{int(gid)}_lag")
+               if gid is not None else None)
+        lines.append("")
+        lines.append(
+            f"  group={_fmt(gid, 0)}/{_fmt(ngroups, 0)} "
+            f"lag={_fmt(lag, 0)} "
+            f"xfers="
+            f"{_fmt(_gauge(lead, 'cross_shard_transfers_total'), 0)} "
+            f"volume="
+            f"{_fmt(_gauge(lead, 'cross_shard_transfer_volume'), 0)} "
+            f"broadcasts="
+            f"{_fmt(_gauge(lead, 'balance_broadcasts_total'), 0)}")
+        rtt = lats.get("transfer_rtt")
+        if rtt:
+            lines.append(
+                f"  transfer_rtt  count={_fmt(rtt.get('count'), 0)} "
+                f"p50={_fmt(rtt.get('p50_ms'), 3)}ms "
+                f"p99={_fmt(rtt.get('p99_ms'), 3)}ms")
+
     lines.append("")
     if stby.get("source"):
         hb = stby.get("hb") or {}

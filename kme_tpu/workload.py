@@ -355,3 +355,60 @@ def cancel_heavy_stream(num_events: int, num_symbols: int, num_accounts: int,
                 msgs.append(gen.create_sell(aid, sid, gen._normal_param(50, 10),
                                             gen._normal_param(50, 10)))
     return msgs
+
+
+def cross_account_stream(num_events: int, num_symbols: int,
+                         num_accounts: int, ngroups: int,
+                         seed: int = 0, cross_frac: float = 0.5,
+                         zipf_a: float = 1.2,
+                         deposit: int = 10_000_000) -> List[OrderMsg]:
+    """Transfer-path sizing profile for the multi-leader topology
+    (bridge/front.py): Zipf-skewed symbol arrival where a configurable
+    fraction of orders is FORCED onto a non-home account — an account
+    whose home group (rendezvous hash of aid) differs from the order's
+    symbol group — so every such order costs the front door a
+    reserve->settle transfer pair. cross_frac=1.0 is the degenerate
+    worst case (100% cross-shard, the bench tail). Seed-deterministic;
+    with ngroups=1 there are no non-home accounts and the stream
+    degenerates to plain Zipf traffic."""
+    from kme_tpu.bridge.front import account_group, symbol_group
+
+    gen = WorkloadGen(num_accounts, num_symbols, seed=seed, validate=True,
+                      payout_opcode_bug=False)
+    msgs: List[OrderMsg] = []
+    for aid in range(num_accounts):
+        msgs.append(gen.create_account(aid))
+        msgs.append(gen.create_transfer(aid, deposit))
+    for sid in range(num_symbols):
+        msgs.append(gen.create_symbol(sid))
+    # account pools keyed by home group: same[g] lives on g, cross[g]
+    # anywhere else (empty pools fall back to the full range)
+    same = {g: [] for g in range(ngroups)}
+    cross = {g: [] for g in range(ngroups)}
+    for aid in range(num_accounts):
+        h = account_group(aid, ngroups)
+        for g in range(ngroups):
+            (same if g == h else cross)[g].append(aid)
+    weights = [1.0 / (r + 1) ** zipf_a for r in range(num_symbols)]
+    total = sum(weights)
+    cdf = []
+    acc = 0.0
+    for w in weights:
+        acc += w / total
+        cdf.append(acc)
+    for _ in range(num_events):
+        sid = bisect.bisect_left(cdf, gen.rng.random())
+        g = symbol_group(sid, ngroups)
+        pool = cross[g] if gen.rng.random() < cross_frac else same[g]
+        aid = (pool[gen._uniform(len(pool))] if pool
+               else gen._uniform(num_accounts))
+        e = gen._uniform(1000)
+        if e < 450:
+            msgs.append(gen.create_buy(aid, sid, gen._normal_param(50, 10),
+                                       gen._normal_param(50, 10)))
+        elif e < 900:
+            msgs.append(gen.create_sell(aid, sid, gen._normal_param(50, 10),
+                                        gen._normal_param(50, 10)))
+        else:
+            msgs.append(gen.create_cancel())
+    return msgs

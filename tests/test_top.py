@@ -153,6 +153,34 @@ def test_render_shows_shard_rows():
     assert "shards=" not in plain
 
 
+def test_render_shows_group_section():
+    """Multi-leader shard group (bridge/front.py): the group line
+    appears iff group_count > 1, with the leader's lag and the
+    cross-shard transfer gauges + RTT quantiles."""
+    node = _node(records=50,
+                 gauges={"group_id": 1, "group_count": 4,
+                         "group1_lag": 7,
+                         "cross_shard_transfers_total": 12,
+                         "cross_shard_transfer_volume": 90000,
+                         "balance_broadcasts_total": 3})
+    node["metrics"]["latencies"] = {
+        "transfer_rtt": {"count": 12, "sum_s": 0.02, "p50_ms": 1.1,
+                         "p90_ms": 2.0, "p99_ms": 3.3, "p999_ms": 3.5}}
+    text = "\n".join(render(build_view(
+        {"t": 1.0, "leader": node, "standby": _node(),
+         "supervisor": None})))
+    assert "group=1/4" in text
+    assert "lag=7" in text
+    assert "xfers=12" in text and "volume=90,000" in text
+    assert "transfer_rtt" in text and "p99=3.300ms" in text
+    # a single-group leader renders no group section
+    solo = _node(records=1, gauges={"group_id": 0, "group_count": 1})
+    plain = "\n".join(render(build_view(
+        {"t": 1.0, "leader": solo, "standby": _node(),
+         "supervisor": None})))
+    assert "group=" not in plain
+
+
 def test_main_once_plain_frame_with_shards(tmp_path, capsys):
     """--once over a heartbeat file carrying the mesh session's shard
     gauges prints the shard rows in the plain frame."""
