@@ -33,6 +33,12 @@ DEFAULT_WIDTH = 4
 # CLI, and the BASELINE.md row).
 DEFAULT_LATENCY_BATCH = 2048
 
+# the five adversarial storm profiles (workload.STORM_PROFILES), usable
+# as --workload names on the engine suites and driven deterministically
+# end-to-end by --suite storms
+STORM_WORKLOADS = ("payout-storm-wide", "flash-crowd", "cancel-storm",
+                   "hot-book", "liquidation-cascade")
+
 
 def _judge_wire(msgs, prefix: int, kw: dict):
     """The quirk-exact judge's wire stream for a message prefix: the
@@ -592,6 +598,11 @@ def bench_seq_engine(events: int = 100_000, symbols: int = 1024,
 
         msgs = payout_storm_stream(events, num_symbols=symbols,
                                    num_accounts=accounts, seed=seed)
+    elif workload in STORM_WORKLOADS:
+        from kme_tpu.workload import storm_stream
+
+        msgs = storm_stream(workload, events, num_symbols=symbols,
+                            num_accounts=accounts, seed=seed)
     else:
         msgs = zipf_symbol_stream(events, num_symbols=symbols,
                                   num_accounts=accounts, seed=seed,
@@ -790,6 +801,11 @@ def bench_lane_engine(events: int = 100_000, symbols: int = 1024,
     if workload == "cancel":
         msgs = cancel_heavy_stream(events, num_symbols=symbols,
                                    num_accounts=accounts, seed=seed)
+    elif workload in STORM_WORKLOADS:
+        from kme_tpu.workload import storm_stream
+
+        msgs = storm_stream(workload, events, num_symbols=symbols,
+                            num_accounts=accounts, seed=seed)
     else:
         msgs = zipf_symbol_stream(events, num_symbols=symbols,
                                   num_accounts=accounts, seed=seed,
@@ -1338,13 +1354,110 @@ def bench_groups(events: int = 20_000, symbols: int = 1024,
     }
 
 
+def bench_storms(events: int = 4000, seed: int = 0,
+                 high_lag: int = 32,
+                 drain_per_msg: float = 2.0) -> dict:
+    """Adversarial-storm shed-policy suite (`--suite storms`): run every
+    STORM_PROFILES stream through the broker's deterministic overload
+    replay (bridge/broker.simulate_overload — no wall clock, no RNG, no
+    threads) and report each profile's shed fraction as a gated metric
+    `shed_frac_<profile>` (perfgate, down-is-better, CPU-deterministic
+    like shard_imbalance). A drift in admission policy, priority
+    classing or the profile generators moves these numbers; nothing
+    else can.
+
+    Each profile's admitted stream is also replayed through the Python
+    oracle — shedding must be a pure input filter, so the surviving
+    sequence has to be processable without crash for every profile (the
+    byte-parity end-to-end proof lives in the kme-chaos storm
+    scenarios; this is the fast in-process survival check), and the
+    whole simulation is run twice to assert determinism.
+    """
+    import time
+
+    from kme_tpu.bridge.broker import OverloadController, simulate_overload
+    from kme_tpu.oracle import OracleEngine
+    from kme_tpu.wire import dumps_order, parse_order
+    from kme_tpu.workload import (STORM_PROFILES, storm_stream,
+                                  storm_windows)
+
+    # reduced-but-sheds scale: small enough for CI seconds, large
+    # enough that EVERY profile's burst overwhelms the modeled drain
+    # (perfgate skips zero baselines, so shed_frac must be > 0)
+    scale = {"payout-storm-wide": (64, 32),
+             "flash-crowd": (32, 32),
+             "cancel-storm": (16, 32),
+             "hot-book": (8, 32),
+             "liquidation-cascade": (32, 32)}
+    t0 = time.perf_counter()
+    per_profile: dict = {}
+    metrics: dict = {}
+    for name in STORM_PROFILES:
+        symbols, accounts = scale[name]
+        msgs = storm_stream(name, events, num_symbols=symbols,
+                            num_accounts=accounts, seed=seed)
+        lines = [dumps_order(m) for m in msgs]
+        windows = storm_windows(name, events, num_symbols=symbols,
+                                num_accounts=accounts)
+        runs = []
+        for _rep in range(2):       # determinism: identical twice
+            ctl = OverloadController(high_lag=high_lag)
+            runs.append(simulate_overload(lines, windows, ctl,
+                                          drain_per_msg=drain_per_msg))
+        sim, sim2 = runs
+        assert sim["admitted_idx"] == sim2["admitted_idx"] \
+            and sim["shed_frac"] == sim2["shed_frac"], (
+                f"simulate_overload is nondeterministic for {name}")
+        if sim["shed"] == 0:
+            raise AssertionError(
+                f"storm profile {name} shed nothing at the suite "
+                f"scale — the gate would silently skip it")
+        # oracle survival of the admitted stream (pure input filter)
+        eng = OracleEngine("fixed")
+        out_lines = 0
+        for i in sim["admitted_idx"]:
+            out_lines += len(eng.process(parse_order(lines[i])))
+        mname = "shed_frac_" + name.replace("-", "_")
+        metrics[mname] = sim["shed_frac"]
+        per_profile[name] = {
+            "records": sim["total"],
+            "admitted": sim["admitted"],
+            "shed": sim["shed"],
+            "shed_frac": sim["shed_frac"],
+            "max_backlog": sim["max_backlog"],
+            "windows": [list(w) for w in windows],
+            "symbols": symbols, "accounts": accounts,
+            "oracle_out_lines": out_lines,
+            "controller": sim["controller"],
+        }
+    elapsed = time.perf_counter() - t0
+    worst = max(metrics.values())
+    detail = {
+        "suite": "storms", "events": events, "seed": seed,
+        "high_lag": high_lag, "drain_per_msg": drain_per_msg,
+        "elapsed_s": round(elapsed, 3),
+        "profiles": per_profile,
+        **{k: round(v, 4) for k, v in metrics.items()},
+    }
+    print(f"kme-bench storms: "
+          + " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items()))
+          + f" ({elapsed:.1f}s)", file=sys.stderr)
+    return {
+        "metric": "storm_shed_frac_max",
+        "value": round(worst, 4),
+        "unit": "shed fraction",
+        "vs_baseline": 0.0,
+        "detail": detail,
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
     p = argparse.ArgumentParser(prog="kme-bench")
     p.add_argument("--suite", choices=("lanes", "parity", "native",
                                        "latency", "pipeline",
-                                       "shards", "groups"),
+                                       "shards", "groups", "storms"),
                    default="lanes")
     p.add_argument("--pipeline", type=int, default=2, metavar="N",
                    help="pipeline suite: in-flight batch window depth "
@@ -1369,14 +1482,16 @@ def main(argv=None) -> int:
                         "(0 = full-width)")
     p.add_argument("--workload",
                    choices=("zipf", "cancel", "zipf-hot",
-                            "payout-storm", "cross-account"),
+                            "payout-storm", "cross-account")
+                   + STORM_WORKLOADS,
                    default="zipf",
                    help="stream profile: Zipf-skewed, bursty cancel/"
                         "replace (BASELINE.md rows), one-symbol hot "
-                        "book (zipf-hot), or mass-settlement bursts "
-                        "(payout-storm) — the latter two are the "
-                        "adversarial profiles of workload.py, "
-                        "seed-deterministic like the rest")
+                        "book (zipf-hot), mass-settlement bursts "
+                        "(payout-storm), or one of the five named "
+                        "adversarial storm profiles "
+                        "(workload.STORM_PROFILES) — all "
+                        "seed-deterministic")
     p.add_argument("--window", type=int, default=1024,
                    help="max scan steps per dispatch window")
     p.add_argument("--parity-prefix", type=int, default=20000,
@@ -1501,6 +1616,8 @@ def main(argv=None) -> int:
                                      else "zipf-hot"),
                            slots=args.slots or 128,
                            max_fills=args.max_fills)
+    elif args.suite == "storms":
+        rec = bench_storms(args.events or 4000, seed=args.seed)
     elif args.suite == "latency":
         rec = bench_latency(args.events or 20_000, args.symbols,
                             args.accounts, args.seed, args.zipf,

@@ -58,9 +58,17 @@ class BrokerError(RuntimeError):
 class BrokerOverload(BrokerError):
     """The bounded ingress queue shed this produce (wire-level
     `rej_overload`, wire.py rej table code 9). Producers should back
-    off and retry; the broker never blocks them."""
+    off and retry; the broker never blocks them.
+
+    When the adaptive controller sheds (rather than the binary
+    `max_lag` bound), `backoff_ms` carries the AIMD producer hint —
+    pause at least this long before re-offering — and `detail` the
+    observed backlog / threshold / degradation state for REJ
+    annotation. Both stay None on the binary path."""
 
     code = "rej_overload"
+    backoff_ms: Optional[int] = None
+    detail: Optional[dict] = None
 
 
 class BrokerFenced(BrokerError):
@@ -99,12 +107,271 @@ class _Topic:
         self.max_out_seq = -1
 
 
+# -- adaptive overload control (SEDA-style, Welsh et al. SOSP '01) ---------
+#
+# The binary `max_lag` bound above sheds EVERYTHING past a fixed backlog —
+# including the cancels and payouts that would actually shrink the book.
+# The controller replaces that cliff with a degradation state machine and
+# priority-aware admission; the binary path stays available and unchanged.
+
+# priority classes: lower admits longer. Book-DRAINING ops are the last
+# thing an overloaded engine should refuse (each admitted cancel/payout
+# REMOVES resting state); ADMIN ops are cheap and rare; fresh ORDERS are
+# what grows the backlog, so they shed first.
+CLS_DRAIN = 0    # CANCEL, PAYOUT, REMOVE_SYMBOL
+CLS_ADMIN = 1    # CREATE_BALANCE, TRANSFER, ADD_SYMBOL
+CLS_ORDER = 2    # BUY, SELL, and anything unparseable
+
+_CLS_BY_ACTION = {4: CLS_DRAIN, 200: CLS_DRAIN, 1: CLS_DRAIN,
+                  100: CLS_ADMIN, 101: CLS_ADMIN, 0: CLS_ADMIN}
+
+
+def classify_produce(value: str):
+    """(priority class, oid, aid) of one wire value. Malformed input is
+    CLS_ORDER — never give garbage the drain-priority fast lane."""
+    try:
+        doc = json.loads(value)
+        action = int(doc.get("action"))
+        oid = int(doc.get("oid") or 0)
+        aid = int(doc.get("aid") or 0)
+    except (ValueError, TypeError, AttributeError):
+        return CLS_ORDER, 0, 0
+    return _CLS_BY_ACTION.get(action, CLS_ORDER), oid, aid
+
+
+class OverloadController:
+    """Degradation state machine with hysteresis + priority admission.
+
+    States (gauge codes): 0 normal — admit everything; 1 shedding —
+    admit DRAIN/ADMIN, ration ORDER flow (linear ramp between the low
+    and drain watermarks) under per-account fairness caps; 2 draining —
+    admit ONLY book-draining ops until the backlog falls back below the
+    high watermark.
+
+    Transitions are driven by the observed backlog (produce side) and
+    an EWMA of admission-to-produce latency (fed by the service):
+
+        normal   -> shedding   backlog >= high_lag OR latency > budget
+        shedding -> draining   backlog >= drain_lag
+        shedding -> normal     backlog <= low_lag AND latency cool
+        draining -> shedding   backlog <  high_lag
+
+    (draining exits only through shedding — the hysteresis that stops
+    the controller flapping at a watermark.)
+
+    The AIMD producer contract rides `BrokerOverload.backoff_ms`: each
+    shed grows the hint additively (bounded); each admitted record in
+    normal state halves it. Producers sleep >= the hint before
+    re-offering and grow their offered rate additively afterwards.
+
+    Deterministic by construction: no wall clock, no RNG — the same
+    (value, backlog) sequence yields the same decisions, which is what
+    lets simulate_overload() gate shed_frac in CI at zero noise.
+    """
+
+    NORMAL, SHEDDING, DRAINING = 0, 1, 2
+    STATE_NAMES = ("normal", "shedding", "draining")
+
+    def __init__(self, high_lag: int, low_lag: Optional[int] = None,
+                 drain_lag: Optional[int] = None,
+                 p99_budget_ms: Optional[float] = None,
+                 account_cap: float = 0.5, fair_window: int = 128,
+                 backoff_step_ms: int = 5,
+                 backoff_max_ms: int = 2000) -> None:
+        if high_lag < 2:
+            raise ValueError("overload high_lag must be >= 2")
+        self.high_lag = int(high_lag)
+        self.low_lag = (max(1, self.high_lag // 2) if low_lag is None
+                        else int(low_lag))
+        self.drain_lag = (self.high_lag * 2 if drain_lag is None
+                          else int(drain_lag))
+        if not (self.low_lag < self.high_lag <= self.drain_lag):
+            raise ValueError("need low_lag < high_lag <= drain_lag")
+        self.p99_budget_ms = p99_budget_ms
+        self.account_cap = float(account_cap)
+        self.fair_window = int(fair_window)
+        self.backoff_step_ms = int(backoff_step_ms)
+        self.backoff_max_ms = int(backoff_max_ms)
+        self.state = self.NORMAL
+        self.backoff_ms = 0
+        self.lat_ewma_ms = 0.0
+        self.transitions = 0
+        self.admitted_by_class = {c: 0 for c in range(3)}
+        self.shed_by_class = {c: 0 for c in range(3)}
+        self.fairness_sheds = 0
+        # ration tokens: in shedding, each arriving ORDER earns
+        # (drain_lag - backlog) tokens out of (drain_lag - low_lag);
+        # one admit costs a full span. Pure integer arithmetic.
+        self._tokens = 0
+        # sliding window of recently admitted ORDER aids for the
+        # fairness cap (one flooder can't take the whole ration)
+        self._fair_ring: List[int] = []
+        self._fair_pos = 0
+        self._fair_counts: Dict[int, int] = {}
+
+    # -- feeds ---------------------------------------------------------
+
+    def observe_latency(self, seconds: float) -> None:
+        """Admission-to-produce latency feed (service e2e stage)."""
+        ms = seconds * 1000.0
+        self.lat_ewma_ms += 0.2 * (ms - self.lat_ewma_ms)
+
+    def _lat_hot(self) -> bool:
+        return (self.p99_budget_ms is not None
+                and self.lat_ewma_ms > self.p99_budget_ms)
+
+    # -- state machine -------------------------------------------------
+
+    def _to(self, state: int) -> None:
+        if state != self.state:
+            self.state = state
+            self.transitions += 1
+
+    def _update_state(self, backlog: int) -> None:
+        if self.state == self.NORMAL:
+            if backlog >= self.drain_lag:
+                self._to(self.DRAINING)
+            elif backlog >= self.high_lag or self._lat_hot():
+                self._to(self.SHEDDING)
+        elif self.state == self.SHEDDING:
+            if backlog >= self.drain_lag:
+                self._to(self.DRAINING)
+            elif backlog <= self.low_lag and not self._lat_hot():
+                self._to(self.NORMAL)
+        else:
+            if backlog < self.high_lag:
+                self._to(self.SHEDDING)
+
+    # -- admission -----------------------------------------------------
+
+    def _fair_blocked(self, aid: int) -> bool:
+        n = len(self._fair_ring)
+        if n < 8:        # no meaningful share signal yet
+            return False
+        return self._fair_counts.get(aid, 0) > self.account_cap * n
+
+    def _fair_admit(self, aid: int) -> None:
+        if self.fair_window <= 0:
+            return
+        if len(self._fair_ring) < self.fair_window:
+            self._fair_ring.append(aid)
+        else:
+            old = self._fair_ring[self._fair_pos]
+            c = self._fair_counts.get(old, 0) - 1
+            if c <= 0:
+                self._fair_counts.pop(old, None)
+            else:
+                self._fair_counts[old] = c
+            self._fair_ring[self._fair_pos] = aid
+            self._fair_pos = (self._fair_pos + 1) % self.fair_window
+        self._fair_counts[aid] = self._fair_counts.get(aid, 0) + 1
+
+    def _shed(self, cls: int, oid: int, aid: int, backlog: int,
+              threshold: int, fairness: bool = False):
+        self.shed_by_class[cls] += 1
+        if fairness:
+            self.fairness_sheds += 1
+        self.backoff_ms = min(self.backoff_max_ms,
+                              self.backoff_ms + self.backoff_step_ms)
+        return False, {"backlog": backlog, "threshold": threshold,
+                       "state": self.STATE_NAMES[self.state],
+                       "cls": cls, "oid": oid, "aid": aid,
+                       "backoff_ms": self.backoff_ms,
+                       "fairness": fairness}
+
+    def admit(self, value: str, backlog: int):
+        """One admission decision: (True, None) or (False, detail)."""
+        cls, oid, aid = classify_produce(value)
+        self._update_state(backlog)
+        if self.state == self.NORMAL:
+            self.admitted_by_class[cls] += 1
+            self.backoff_ms //= 2
+            return True, None
+        if self.state == self.DRAINING:
+            if cls == CLS_DRAIN:
+                self.admitted_by_class[cls] += 1
+                return True, None
+            return self._shed(cls, oid, aid, backlog, self.drain_lag)
+        # SHEDDING
+        if cls != CLS_ORDER:
+            self.admitted_by_class[cls] += 1
+            return True, None
+        if self._fair_blocked(aid):
+            return self._shed(cls, oid, aid, backlog, self.high_lag,
+                              fairness=True)
+        span = self.drain_lag - self.low_lag
+        room = max(0, self.drain_lag - backlog)
+        self._tokens += min(room, span)
+        if self._tokens >= span:
+            self._tokens -= span
+            self.admitted_by_class[cls] += 1
+            self._fair_admit(aid)
+            return True, None
+        return self._shed(cls, oid, aid, backlog, self.high_lag)
+
+    def snapshot(self) -> dict:
+        return {"state": self.STATE_NAMES[self.state],
+                "state_code": self.state,
+                "backoff_ms": self.backoff_ms,
+                "lat_ewma_ms": round(self.lat_ewma_ms, 3),
+                "transitions": self.transitions,
+                "admitted_by_class": dict(self.admitted_by_class),
+                "shed_by_class": dict(self.shed_by_class),
+                "fairness_sheds": self.fairness_sheds}
+
+
+def simulate_overload(values: List[str], windows, controller:
+                      OverloadController, drain_per_msg: float = 2.0
+                      ) -> dict:
+    """Deterministic arrival/drain replay of the admission logic — the
+    CI-gated half of the storm suite (live chaos runs prove parity and
+    SLOs; this proves the shed POLICY never drifts unnoticed).
+
+    Each message is one arrival tick. At base pacing the consumer
+    drains `drain_per_msg` records per tick; inside a burst window
+    (lo, hi, mult) arrivals outpace the drain mult-fold, so the drain
+    credit is scaled by 1/mult. No wall clock, no RNG: the same
+    (values, windows, controller params) triple yields bit-identical
+    results on any machine.
+    """
+    backlog = 0
+    credit = 0.0
+    admitted_idx: List[int] = []
+    max_backlog = 0
+    for i, v in enumerate(values):
+        mult = 1
+        for lo, hi, m in windows:
+            if lo <= i < hi:
+                mult = m
+                break
+        credit += drain_per_msg / mult
+        drains = int(credit)
+        if drains:
+            credit -= drains
+            backlog = max(0, backlog - drains)
+        ok, _ = controller.admit(v, backlog)
+        if ok:
+            admitted_idx.append(i)
+            backlog += 1
+            if backlog > max_backlog:
+                max_backlog = backlog
+    total = len(values)
+    shed = total - len(admitted_idx)
+    return {"total": total, "admitted": len(admitted_idx),
+            "shed": shed,
+            "shed_frac": (shed / total) if total else 0.0,
+            "max_backlog": max_backlog,
+            "admitted_idx": admitted_idx,
+            "controller": controller.snapshot()}
+
+
 class InProcessBroker:
     """The broker API the rest of the bridge codes against. The TCP
     client (tcp.TcpBroker) implements the same three methods."""
 
     def __init__(self, persist_dir: Optional[str] = None,
-                 max_lag: Optional[int] = None) -> None:
+                 max_lag: Optional[int] = None,
+                 overload: Optional[OverloadController] = None) -> None:
         self._topics: Dict[str, _Topic] = {}
         self._lock = threading.Lock()
         self._data = threading.Condition(self._lock)
@@ -118,6 +385,16 @@ class InProcessBroker:
         self._max_lag = max_lag
         self._commits: Dict[str, int] = {}
         self.overload_rejects = 0
+        # adaptive overload control: an OverloadController makes the
+        # shed decision priority-aware (same arming rule as max_lag —
+        # only topics with a committed watermark are bounded). The
+        # binary max_lag check above it is untouched and wins first.
+        self.overload = overload
+        # fn(topic, detail) called AFTER a controller shed, outside the
+        # broker lock (MatchService wires this to --annotate-rejects so
+        # shed storms are debuggable from the journal). Must not call
+        # back into the broker.
+        self.shed_observer = None
         # exactly-once state (recovered from log stamps on reload)
         self._fence_epoch = 0
         self.fenced_produces = 0
@@ -247,21 +524,46 @@ class InProcessBroker:
                     f"rej_overload: topic {topic!r} backlog "
                     f"{len(t.log) - self._commits[topic]} >= max_lag "
                     f"{self._max_lag}")
-            off = len(t.log)
-            import time as _time
+            shed_detail = None
+            if self.overload is not None and topic in self._commits:
+                ok, shed_detail = self.overload.admit(
+                    value, len(t.log) - self._commits[topic])
+                if not ok:
+                    self.overload_rejects += 1
+            if shed_detail is None:
+                off = len(t.log)
+                import time as _time
 
-            t.log.append(Record(off, key, value, epoch, out_seq,
-                                _time.time_ns() // 1000))
-            if out_seq is not None:
-                t.max_out_seq = out_seq
-            if t.logfile is not None:
-                row = ([key, value] if epoch is None and out_seq is None
-                       else [key, value, epoch, out_seq])
-                t.logfile.write(json.dumps(row,
-                                           separators=(",", ":")) + "\n")
-                t.logfile.flush()
-            self._data.notify_all()
-            return off
+                t.log.append(Record(off, key, value, epoch, out_seq,
+                                    _time.time_ns() // 1000))
+                if out_seq is not None:
+                    t.max_out_seq = out_seq
+                if t.logfile is not None:
+                    row = ([key, value]
+                           if epoch is None and out_seq is None
+                           else [key, value, epoch, out_seq])
+                    t.logfile.write(json.dumps(row,
+                                               separators=(",", ":"))
+                                    + "\n")
+                    t.logfile.flush()
+                self._data.notify_all()
+                return off
+        # controller shed: annotate + raise OUTSIDE the broker lock (the
+        # observer may touch journals/telemetry; it must never deadlock a
+        # concurrent fetch)
+        obs = self.shed_observer
+        if obs is not None:
+            try:
+                obs(topic, shed_detail)
+            except Exception:
+                pass        # observability must never mask the shed
+        exc = BrokerOverload(
+            f"rej_overload: topic {topic!r} backlog "
+            f"{shed_detail['backlog']} state {shed_detail['state']} "
+            f"(adaptive shed, backoff {shed_detail['backoff_ms']} ms)")
+        exc.backoff_ms = shed_detail["backoff_ms"]
+        exc.detail = shed_detail
+        raise exc
 
     def fence(self, epoch: int) -> None:
         """Advance the fence so every produce stamped below `epoch` is

@@ -663,6 +663,329 @@ def run_shard_failover(args, run_dir: str, report_path: str) -> int:
     return 0 if report["ok"] else 1
 
 
+def scenario_registry() -> dict:
+    """name -> one-line description for every runnable scenario: the
+    three recovery drills plus the five adversarial storm profiles
+    (workload.STORM_PROFILES). `kme-chaos --list-scenarios` prints it."""
+    from kme_tpu.workload import STORM_PROFILES
+
+    reg = {
+        "default": "at-least-once recovery gauntlet: every fault class "
+                   "(transport, snapshot, journal, kill, stall), "
+                   "verify_stream prefix+replay composition",
+        "failover": "hot-standby promotion under exactly-once: SIGKILL "
+                    "the leader mid-stream, bounded promotion, epoch "
+                    "fencing, deduped stream byte-exact",
+        "shard-failover": "multi-leader drill: kill the busiest "
+                          "group's leader; survivors must not dip, "
+                          "merged stream byte-exact, zero duplicate "
+                          "stamps",
+    }
+    for name, prof in STORM_PROFILES.items():
+        reg[name] = (f"storm: {prof.summary} (adaptive overload "
+                     f"control, oracle parity over the admitted "
+                     f"stream, SLO verdict)")
+    return reg
+
+
+class _StormProducer(threading.Thread):
+    """Per-record MatchIn feeder for the storm scenarios. Unlike
+    _Producer it does NOT retry a shed record: the adaptive controller's
+    rej_overload means the record was rejected at admission, and
+    shedding must act as a pure input filter — the dropped record simply
+    never existed as far as the engine (and the oracle replay of the
+    admitted stream) is concerned. The producer honors the AIMD backoff
+    hint carried on the reject and classifies every offer/shed by
+    priority class for the fairness verdict."""
+
+    def __init__(self, host: str, port: int, lines: List[str],
+                 windows: List[Tuple[int, int, int]],
+                 pace_s: float) -> None:
+        super().__init__(daemon=True)
+        self.host, self.port = host, port
+        self.lines, self.windows, self.pace_s = lines, windows, pace_s
+        self.offered = 0
+        self.sheds = 0
+        self.reconnects = 0
+        self.backoff_slept_ms = 0.0
+        self.offered_by_class = {0: 0, 1: 0, 2: 0}
+        self.shed_by_class = {0: 0, 1: 0, 2: 0}
+        self.stop = threading.Event()
+
+    def run(self) -> None:
+        from kme_tpu.bridge.broker import (BrokerError, BrokerOverload,
+                                           classify_produce)
+        from kme_tpu.bridge.provision import provision
+        from kme_tpu.bridge.tcp import TcpBroker
+
+        client = None
+        i = 0
+        while i < len(self.lines) and not self.stop.is_set():
+            cls, _, _ = classify_produce(self.lines[i])
+            burst = any(lo <= i < hi for lo, hi, _ in self.windows)
+            try:
+                if client is None:
+                    client = TcpBroker(self.host, self.port,
+                                       timeout=10.0)
+                    provision(client)           # idempotent
+                client.produce(TOPIC_IN, None, self.lines[i])
+                self.offered += 1
+                self.offered_by_class[cls] += 1
+                i += 1
+                # rate lives in producer pacing: flat-out inside a
+                # burst window, paced in the steady state
+                if not burst and self.pace_s > 0:
+                    time.sleep(self.pace_s)
+            except BrokerOverload as e:
+                self.offered += 1
+                self.offered_by_class[cls] += 1
+                self.sheds += 1
+                self.shed_by_class[cls] += 1
+                i += 1                          # dropped, not retried
+                hint = getattr(e, "backoff_ms", None)
+                if hint:
+                    nap = min(int(hint), 100) / 1e3
+                    self.backoff_slept_ms += nap * 1e3
+                    time.sleep(nap)
+            except (BrokerError, OSError):
+                # serve still coming up, or a transient transport blip:
+                # reconnect and retry the SAME record (no faults are
+                # injected in a storm run, so ambiguity is startup-only)
+                if client is not None:
+                    try:
+                        client.close()
+                    except OSError:
+                        pass
+                client = None
+                self.reconnects += 1
+                time.sleep(0.2)
+        if client is not None:
+            try:
+                client.close()
+            except OSError:
+                pass
+
+
+def run_storm(args, run_dir: str, report_path: str) -> int:
+    """--scenario <storm-name>: drive one adversarial storm profile
+    (workload.STORM_PROFILES) at a supervise-free kme-serve running the
+    adaptive overload controller, then prove graceful degradation:
+
+    - ORACLE PARITY over the admitted stream: the durable MatchIn log
+      IS the admitted sequence (everything the controller let through);
+      an in-process oracle replay of exactly that sequence must match
+      the deduped durable MatchOut BYTE-EXACTLY, with ZERO duplicate
+      (epoch, out_seq) stamps — shedding is a pure input filter, never
+      a corruption;
+    - SLO VERDICT: the final heartbeat's lat_e2e p99 (broker admission
+      -> outputs visible) must sit under --storm-p99-ms, and admitted
+      throughput must clear --storm-min-tput records/s;
+    - PRIORITY FAIRNESS: when anything shed, book-shrinking traffic
+      (cancels/payouts, class 0) must shed at a strictly lower rate
+      than new orders (class 2) — the whole point of priority-aware
+      admission;
+    - at least --min-sheds records actually shed (a storm that never
+      pushed the controller proves nothing).
+    """
+    from kme_tpu.bridge.consume import DedupRing
+    from kme_tpu.wire import dumps_order
+    from kme_tpu.workload import (STORM_PROFILES, storm_stream,
+                                  storm_windows)
+
+    prof = STORM_PROFILES[args.scenario]
+    symbols = args.storm_symbols or prof.symbols
+    accounts = args.storm_accounts or prof.accounts
+    msgs = storm_stream(args.scenario, args.events,
+                        num_symbols=symbols, num_accounts=accounts,
+                        seed=args.seed)
+    lines = [dumps_order(m) for m in msgs]
+    windows = storm_windows(args.scenario, args.events,
+                            num_symbols=symbols, num_accounts=accounts)
+    ckpt_dir = os.path.join(run_dir, "state")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    health = os.path.join(ckpt_dir, "serve.health")
+    log_dir = os.path.join(ckpt_dir, "broker-log")
+    port = _free_port()
+    print(f"kme-chaos: scenario={args.scenario} seed={args.seed} "
+          f"events={args.events} symbols={symbols} accounts={accounts} "
+          f"records={len(lines)} windows={windows} "
+          f"high_lag={args.overload_high_lag}\n"
+          f"kme-chaos: run dir {run_dir}", file=sys.stderr)
+
+    serve_cmd = [sys.executable, "-m", "kme_tpu.cli", "serve",
+                 "--engine", args.engine, "--compat", "fixed",
+                 "--batch", str(args.batch),
+                 "--slots", str(args.slots),
+                 "--max-fills", str(args.max_fills),
+                 "--symbols", str(max(symbols, 8)),
+                 "--accounts", str(max(accounts + 8, 128)),
+                 "--checkpoint-dir", ckpt_dir,
+                 "--checkpoint-every", str(args.checkpoint_every),
+                 "--overload-high-lag", str(args.overload_high_lag),
+                 "--listen", f"127.0.0.1:{port}",
+                 "--idle-exit", str(args.idle_exit),
+                 "--health-file", health,
+                 "--health-every", "0.1"]
+    if not args.no_journal:
+        serve_cmd += ["--journal-out",
+                      os.path.join(run_dir, "journal.jsonl")]
+    env = dict(os.environ)
+    env.pop("KME_FAULTS", None)     # the storm itself is the attack
+    env.pop("KME_FAULTS_STATE", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.time()
+    srv = subprocess.Popen(serve_cmd, env=env)
+    producer = _StormProducer("127.0.0.1", port, lines, windows,
+                              pace_s=args.pace_ms / 1e3)
+    producer.start()
+
+    rc: Optional[int] = None
+    deadline = t0 + args.timeout
+    while time.time() < deadline:
+        rc = srv.poll()
+        if rc is not None:
+            break
+        time.sleep(0.25)
+    if rc is None:
+        print(f"kme-chaos: TIMEOUT after {args.timeout}s; killing "
+              f"kme-serve", file=sys.stderr)
+        srv.kill()
+        srv.wait()
+        rc = srv.returncode
+    producer.stop.set()
+    producer.join(timeout=10.0)
+    elapsed = time.time() - t0
+
+    failures: List[str] = []
+    if rc != 0:
+        failures.append(f"kme-serve exited rc={rc}")
+    if producer.offered < len(lines):
+        failures.append(f"producer only offered {producer.offered} of "
+                        f"{len(lines)} records")
+
+    # oracle parity over the ADMITTED stream: the durable MatchIn log
+    # is ground truth for what got past the controller
+    admitted_lines = [r.value for r in
+                      read_matchout_records(log_dir, topic=TOPIC_IN)]
+    per_msg = expected_groups(admitted_lines, args.slots,
+                              args.max_fills)
+    flat = [ln for g in per_msg for ln in g]
+    out_recs = read_matchout_records(log_dir)
+    ring = DedupRing()
+    visible = [f"{r.key} {r.value}" for r in out_recs
+               if not ring.is_dup(r.epoch, r.out_seq)]
+    parity = {"admitted_records": len(admitted_lines),
+              "got_lines": len(visible),
+              "expected_lines": len(flat),
+              "duplicate_stamps": ring.suppressed}
+    if ring.suppressed:
+        failures.append(f"{ring.suppressed} duplicate (epoch,out_seq) "
+                        f"stamp(s) in the durable MatchOut log")
+    if visible != flat:
+        n = min(len(visible), len(flat))
+        div = next((k for k in range(n) if visible[k] != flat[k]), n)
+        parity["error"] = (f"admitted-stream replay diverges at line "
+                           f"{div} (got {len(visible)}, want "
+                           f"{len(flat)})")
+        failures.append(f"oracle parity over the admitted stream "
+                        f"FAILED: {parity['error']}")
+
+    # shed accounting + priority fairness (producer-side ground truth)
+    shed = producer.sheds
+    shed_frac = shed / max(1, producer.offered)
+    if shed < args.min_sheds:
+        failures.append(f"only {shed} record(s) shed; the storm never "
+                        f"pushed the controller (need >= "
+                        f"{args.min_sheds})")
+
+    def _rate(cls: int) -> Optional[float]:
+        n = producer.offered_by_class[cls]
+        return producer.shed_by_class[cls] / n if n else None
+
+    rates = {cls: _rate(cls) for cls in (0, 1, 2)}
+    if shed and producer.offered_by_class[0] \
+            and rates[2] is not None:
+        if rates[0] is None or rates[0] >= rates[2]:
+            failures.append(
+                f"priority inversion: class-0 (cancel/payout) shed "
+                f"rate {rates[0]} is not strictly below class-2 (new "
+                f"order) shed rate {rates[2]}")
+
+    # SLO verdict from the final heartbeat
+    slo: dict = {"p99_bound_ms": args.storm_p99_ms,
+                 "min_tput": args.storm_min_tput}
+    gauges: dict = {}
+    try:
+        with open(health) as f:
+            hb = json.load(f)
+        met = hb.get("metrics", {})
+        gauges = met.get("gauges", {})
+        slo["p99_ms"] = met.get("latencies", {}).get(
+            "lat_e2e", {}).get("p99_ms")
+    except (OSError, ValueError):
+        slo["p99_ms"] = None
+    admitted = producer.offered - shed
+    slo["tput"] = round(admitted / elapsed, 1) if elapsed > 0 else None
+    if slo["p99_ms"] is None:
+        failures.append("no lat_e2e p99 in the final heartbeat")
+    elif slo["p99_ms"] > args.storm_p99_ms:
+        failures.append(f"SLO: p99 admission-to-produce "
+                        f"{slo['p99_ms']:.1f}ms over the "
+                        f"{args.storm_p99_ms}ms bound")
+    if slo["tput"] is not None and slo["tput"] < args.storm_min_tput:
+        failures.append(f"SLO: survivor throughput {slo['tput']}/s "
+                        f"under the {args.storm_min_tput}/s floor")
+    slo["ok"] = not any(f.startswith("SLO:") for f in failures)
+
+    report = {
+        "ok": not failures,
+        "failures": failures,
+        "scenario": args.scenario,
+        "summary": prof.summary,
+        "seed": args.seed,
+        "events": args.events,
+        "symbols": symbols,
+        "accounts": accounts,
+        "records": len(lines),
+        "windows": [list(w) for w in windows],
+        "elapsed_seconds": round(elapsed, 3),
+        "offered": producer.offered,
+        "admitted": admitted,
+        "shed": shed,
+        "shed_frac": round(shed_frac, 4),
+        "offered_by_class": producer.offered_by_class,
+        "shed_by_class": producer.shed_by_class,
+        "shed_rates_by_class": {str(k): (round(v, 4)
+                                         if v is not None else None)
+                                for k, v in rates.items()},
+        "backoff_slept_ms": round(producer.backoff_slept_ms, 1),
+        "reconnects": producer.reconnects,
+        "slo": slo,
+        "parity": parity,
+        "controller_gauges": {k: v for k, v in gauges.items()
+                              if k.startswith("overload_")
+                              or k.startswith("shed_by_class")
+                              or k.startswith("admitted_by_class")},
+        "run_dir": run_dir,
+    }
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1)
+    status = "OK" if report["ok"] else "FAILED"
+    print(f"kme-chaos: {status} — {args.scenario}: offered "
+          f"{producer.offered}, shed {shed} ({shed_frac:.1%}), "
+          f"rates by class {report['shed_rates_by_class']}, "
+          f"p99={slo['p99_ms']}ms (bound {args.storm_p99_ms}ms), "
+          f"tput={slo['tput']}/s, parity="
+          f"{'byte-exact' if 'error' not in parity else 'DIVERGED'}, "
+          f"dup_stamps={ring.suppressed}, elapsed={elapsed:.1f}s",
+          file=sys.stderr)
+    for fail in failures:
+        print(f"kme-chaos: FAIL: {fail}", file=sys.stderr)
+    print(f"kme-chaos: report written to {report_path}",
+          file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
 def _fault_fires(state_dir: str) -> dict:
     fires = {}
     try:
@@ -682,8 +1005,14 @@ def main(argv=None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("--seed", type=int, default=0,
                    help="seeds the workload AND every fault rule")
-    p.add_argument("--scenario", choices=("default", "failover",
-                                          "shard-failover"),
+    from kme_tpu.workload import STORM_PROFILES
+
+    p.add_argument("--list-scenarios", action="store_true",
+                   help="print the scenario registry (name + one-line "
+                        "description) and exit")
+    p.add_argument("--scenario",
+                   choices=("default", "failover", "shard-failover")
+                   + tuple(STORM_PROFILES),
                    default="default",
                    help="default = the at-least-once recovery gauntlet "
                         "(every fault class, verify_stream); failover "
@@ -700,7 +1029,12 @@ def main(argv=None) -> int:
                         "must not dip >=10%, the standby must promote "
                         "within --max-failover, the merged stream "
                         "must be byte-exact and no durable log may "
-                        "hold a duplicate (epoch,out_seq) stamp")
+                        "hold a duplicate (epoch,out_seq) stamp; any "
+                        "storm-profile name (--list-scenarios) = drive "
+                        "that adversarial workload at the adaptive "
+                        "overload controller and verify oracle parity "
+                        "over the admitted stream, priority fairness "
+                        "and the SLO verdict")
     p.add_argument("--groups", type=int, default=2,
                    help="shard-failover scenario: number of shard "
                         "groups (leader pairs)")
@@ -715,6 +1049,30 @@ def main(argv=None) -> int:
     p.add_argument("--max-failover", type=float, default=2.0,
                    help="failover scenario: max seconds from failure "
                         "detection to the promoted replica serving")
+    p.add_argument("--storm-symbols", type=int, default=None,
+                   help="storm scenarios: override the profile's "
+                        "symbol-universe width (reduced-scale CI runs)")
+    p.add_argument("--storm-accounts", type=int, default=None,
+                   help="storm scenarios: override the profile's "
+                        "account count")
+    p.add_argument("--storm-p99-ms", type=float, default=2000.0,
+                   help="storm scenarios: SLO bound on the lat_e2e p99 "
+                        "(broker admission -> outputs visible)")
+    p.add_argument("--storm-min-tput", type=float, default=10.0,
+                   help="storm scenarios: survivor throughput floor "
+                        "(admitted records/s over the whole run)")
+    p.add_argument("--min-sheds", type=int, default=1,
+                   help="storm scenarios: fail unless at least this "
+                        "many records were shed (a storm that never "
+                        "pushed the controller proves nothing)")
+    p.add_argument("--pace-ms", type=float, default=1.0,
+                   help="storm scenarios: per-record producer pacing "
+                        "OUTSIDE burst windows (inside a window the "
+                        "producer runs flat out — that asymmetry IS "
+                        "the storm's rate multiplier)")
+    p.add_argument("--overload-high-lag", type=int, default=48,
+                   help="storm scenarios: the adaptive controller's "
+                        "shedding threshold passed to kme-serve")
     p.add_argument("--events", type=int, default=2000)
     p.add_argument("--accounts", type=int, default=10)
     p.add_argument("--symbols", type=int, default=3)
@@ -760,6 +1118,13 @@ def main(argv=None) -> int:
                         "<dir>/chaos-report.json)")
     args = p.parse_args(argv)
 
+    if args.list_scenarios:
+        reg = scenario_registry()
+        width = max(len(n) for n in reg)
+        for name, desc in reg.items():
+            print(f"{name:<{width}}  {desc}")
+        return 0
+
     from kme_tpu.wire import dumps_order
     from kme_tpu.workload import harness_stream
 
@@ -774,6 +1139,10 @@ def main(argv=None) -> int:
         report_path = args.report or os.path.join(
             run_dir, "chaos-report.json")
         return run_shard_failover(args, run_dir, report_path)
+    if args.scenario in STORM_PROFILES:
+        report_path = args.report or os.path.join(
+            run_dir, "chaos-report.json")
+        return run_storm(args, run_dir, report_path)
     ckpt_dir = os.path.join(run_dir, "state")
     state_dir = os.path.join(run_dir, "fault-state")
     os.makedirs(ckpt_dir, exist_ok=True)

@@ -62,6 +62,34 @@ def main(argv=None) -> int:
                         "unconsumed backlog reaches N records (shed "
                         "load instead of stalling); in-process broker "
                         "only")
+    p.add_argument("--overload-high-lag", type=int, default=None,
+                   metavar="N",
+                   help="adaptive overload control: instead of the "
+                        "binary --max-lag shed, run the normal -> "
+                        "shedding -> draining degradation state machine "
+                        "with priority-aware admission (cancels/payouts "
+                        "pass while new orders shed, per-account "
+                        "fairness caps) once the MatchIn backlog "
+                        "reaches N; in-process broker only")
+    p.add_argument("--overload-low-lag", type=int, default=None,
+                   metavar="N",
+                   help="hysteresis low-water mark: leave shedding once "
+                        "the backlog falls to N (default high/2)")
+    p.add_argument("--overload-drain-lag", type=int, default=None,
+                   metavar="N",
+                   help="draining high-water mark: admit ONLY book-"
+                        "shrinking traffic (cancel/payout/remove) past "
+                        "N (default 2*high)")
+    p.add_argument("--overload-p99-ms", type=float, default=None,
+                   metavar="MS",
+                   help="also enter shedding when the admission-to-"
+                        "produce latency EWMA exceeds MS ms, even "
+                        "below the backlog threshold")
+    p.add_argument("--overload-account-cap", type=float, default=0.5,
+                   metavar="FRAC",
+                   help="per-account fairness cap: shed an account's "
+                        "new orders while it holds more than FRAC of "
+                        "the recent admitted-order window (default 0.5)")
     p.add_argument("--log-dir", default=None, metavar="DIR",
                    help="persist topic logs here (append-only JSONL) so "
                         "the broker survives restarts; defaults to "
@@ -199,8 +227,19 @@ def main(argv=None) -> int:
         log_dir = args.log_dir
         if log_dir is None and args.checkpoint_dir is not None:
             log_dir = os.path.join(args.checkpoint_dir, "broker-log")
+        overload = None
+        if args.overload_high_lag is not None:
+            from kme_tpu.bridge.broker import OverloadController
+
+            overload = OverloadController(
+                high_lag=args.overload_high_lag,
+                low_lag=args.overload_low_lag,
+                drain_lag=args.overload_drain_lag,
+                p99_budget_ms=args.overload_p99_ms,
+                account_cap=args.overload_account_cap)
         broker = InProcessBroker(persist_dir=log_dir,
-                                 max_lag=args.max_lag)
+                                 max_lag=args.max_lag,
+                                 overload=overload)
         host, port = parse_addr(args.listen)
         srv, broker = serve_broker(host, port, broker)
         real_host, real_port = srv.server_address[:2]

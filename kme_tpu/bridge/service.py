@@ -183,6 +183,19 @@ class MatchService:
         self._slo_arg = slo         # dict of SLO kwargs, or None
         self.slo = None
         self._slo_reason = None
+        # adaptive-shed annotations: controller sheds happen on the TCP
+        # produce thread; queue the details and emit REJ rows (with
+        # backlog/threshold/state) from the poll thread so shed storms
+        # are debuggable from the output stream alone
+        self._shed_pending = None
+        if (annotate_rejects
+                and getattr(broker, "overload", None) is not None
+                and hasattr(broker, "shed_observer")):
+            import collections
+
+            q = collections.deque(maxlen=65536)
+            self._shed_pending = q
+            broker.shed_observer = lambda _topic, d: q.append(d)
         resumed = False
         if checkpoint_dir is not None:
             resumed = self._try_resume(engine, compat, shards, width)
@@ -801,9 +814,18 @@ class MatchService:
                     round(dev_d * 1e3, 3))
             if self._last_produce_s > 0:
                 lat["produce"].observe(self._last_produce_s, n)
+            e2e_hot = 0.0
             for ats in atss:
                 if ats is not None:
-                    lat["e2e"].observe(max(0, done_us - ats) * 1e-6)
+                    d = max(0, done_us - ats) * 1e-6
+                    lat["e2e"].observe(d)
+                    if d > e2e_hot:
+                        e2e_hot = d
+            ctl = getattr(self.broker, "overload", None)
+            if ctl is not None and e2e_hot > 0:
+                # admission-to-produce feed for the degradation state
+                # machine (latency can trip shedding before backlog does)
+                ctl.observe_latency(e2e_hot)
         if self.journal is not None and (out or drops):
             self.journal.record_batch(out or [], reasons=reasons,
                                       offsets=offs[:len(out or [])],
@@ -965,9 +987,16 @@ class MatchService:
                 round(dev_d * 1e3, 3))
         if self._last_produce_s > 0:
             lat["produce"].observe(self._last_produce_s, n)
+        e2e_hot = 0.0
         for ats in atss:
             if ats is not None:
-                lat["e2e"].observe(max(0, done_us - ats) * 1e-6)
+                d = max(0, done_us - ats) * 1e-6
+                lat["e2e"].observe(d)
+                if d > e2e_hot:
+                    e2e_hot = d
+        ctl = getattr(self.broker, "overload", None)
+        if ctl is not None and e2e_hot > 0:
+            ctl.observe_latency(e2e_hot)
         if self.journal is not None and n:
             out = self._lines_of(buf, line_off, msg_lines)
             self.journal.record_batch(out, reasons=reasons,
@@ -1047,6 +1076,29 @@ class MatchService:
         shed = getattr(self.broker, "overload_rejects", None)
         if shed is not None:
             t.gauge("overload_rejects").set(shed)
+        ctl = getattr(self.broker, "overload", None)
+        if ctl is not None:
+            # adaptive-controller surface (kme-top shows a degradation
+            # row keyed on overload_state being present)
+            t.gauge("overload_state",
+                    "degradation state: 0 normal / 1 shedding / "
+                    "2 draining").set(ctl.state)
+            t.gauge("overload_backoff_ms",
+                    "AIMD producer backoff hint carried on "
+                    "rej_overload").set(ctl.backoff_ms)
+            t.gauge("overload_transitions",
+                    "degradation state-machine transitions").set(
+                ctl.transitions)
+            t.gauge("overload_fairness_sheds",
+                    "class-2 sheds forced by the per-account "
+                    "fairness cap").set(ctl.fairness_sheds)
+            for cls in range(3):
+                t.gauge(f"shed_by_class{cls}").set(
+                    ctl.shed_by_class[cls])
+                t.gauge(f"admitted_by_class{cls}").set(
+                    ctl.admitted_by_class[cls])
+            if self._shed_pending is not None:
+                self._drain_shed_annotations()
         self._publish_eos_gauges()
         if self.journal is not None:
             t.gauge("journal_last_offset",
@@ -1274,6 +1326,26 @@ class MatchService:
                 code = REJ_UNSPECIFIED
             self._produce_retry(self.topic_out, "REJ", rej_record_json(
                 m["oid"], m["aid"], code))
+
+    def _drain_shed_annotations(self) -> None:
+        """REJ rows for controller sheds. The shed never reached the
+        engine (it is a produce-time refusal), so the annotation is the
+        only durable trace — it carries the observed backlog, the
+        active threshold, the degradation state and the backoff hint."""
+        from kme_tpu.wire import REJ_OVERLOAD, rej_record_json
+
+        q = self._shed_pending
+        while True:
+            try:
+                d = q.popleft()
+            except IndexError:
+                break
+            self._produce_retry(self.topic_out, "REJ", rej_record_json(
+                d.get("oid", 0), d.get("aid", 0), REJ_OVERLOAD,
+                detail={"backlog": d["backlog"],
+                        "threshold": d["threshold"],
+                        "state": d["state"],
+                        "backoff_ms": d["backoff_ms"]}))
 
     def _degrade_to_native(self, reason: str) -> None:
         """One-way engine degradation for java-mode streams that leave

@@ -111,6 +111,10 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = {"ok": False, "error": f"unknown op {op!r}"}
             except (BrokerOverload, BrokerFenced) as e:
                 resp = {"ok": False, "error": str(e), "code": e.code}
+                # AIMD producer backoff hint from the adaptive overload
+                # controller rides the rej_overload wire row
+                if getattr(e, "backoff_ms", None) is not None:
+                    resp["backoff_ms"] = e.backoff_ms
             except BrokerError as e:
                 resp = {"ok": False, "error": str(e)}
             except (KeyError, ValueError, TypeError) as e:
@@ -213,7 +217,10 @@ class TcpBroker:
         if not resp.get("ok"):
             err = resp.get("error", "unknown broker error")
             if resp.get("code") == BrokerOverload.code:
-                raise BrokerOverload(err)
+                exc = BrokerOverload(err)
+                if resp.get("backoff_ms") is not None:
+                    exc.backoff_ms = int(resp["backoff_ms"])
+                raise exc
             if resp.get("code") == BrokerFenced.code:
                 raise BrokerFenced(err)
             raise BrokerError(err)

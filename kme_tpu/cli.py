@@ -64,13 +64,16 @@ def loadgen_main(argv=None) -> int:
                     client.produce_batch(
                         TOPIC_IN, [(None, dumps_order(m))
                                    for m in msgs[lo:lo + 4096]])
-                except BrokerOverload:
-                    # bounded ingress (kme-serve --max-lag): the broker
-                    # sheds load instead of growing the backlog — treat
-                    # as backpressure and re-offer the batch from the
-                    # broker's durable high-water mark
+                except BrokerOverload as e:
+                    # bounded ingress (kme-serve --max-lag) or adaptive
+                    # shedding (--overload-high-lag): the broker sheds
+                    # load instead of growing the backlog — treat as
+                    # backpressure, honoring the AIMD backoff hint when
+                    # the controller sent one, and re-offer the batch
+                    # from the broker's durable high-water mark
                     shed += 1
-                    time.sleep(0.1)
+                    hint = getattr(e, "backoff_ms", None)
+                    time.sleep(hint / 1e3 if hint else 0.1)
                     lo = client.end_offset(TOPIC_IN)
                     continue
                 lo += 4096

@@ -61,6 +61,16 @@ GATED_METRICS = {
     # the fixed-seed suite — fully deterministic (router + prefund
     # policy, no wall-clock term), so it gates at zero noise
     "cross_shard_transfer_frac": "down",
+    # adversarial storms (ISSUE r10): per-profile shed fraction from
+    # the deterministic overload replay (broker.simulate_overload —
+    # no wall clock, no RNG), gated vs BASELINE_storms.json at zero
+    # noise; a drift means the admission policy or a profile generator
+    # changed behavior
+    "shed_frac_payout_storm_wide": "down",
+    "shed_frac_flash_crowd": "down",
+    "shed_frac_cancel_storm": "down",
+    "shed_frac_hot_book": "down",
+    "shed_frac_liquidation_cascade": "down",
 }
 
 # reported-only: too noisy to gate on (documented flappers)

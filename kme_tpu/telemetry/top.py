@@ -166,6 +166,36 @@ def render(view: dict, width: int = 78) -> list:
         lines.append("  pipeline_warning: speedup < 1.0 "
                      "(see measured_overlap_s)")
 
+    # degradation row (adaptive overload controller, kme-serve
+    # --overload-high-lag): only rendered when the controller is
+    # active — overload_state is absent on a binary-max_lag or
+    # unbounded-ingress leader
+    ostate = _gauge(lead, "overload_state")
+    if ostate is not None:
+        names = ("normal", "shedding", "draining")
+        sname = (names[int(ostate)] if 0 <= int(ostate) < 3
+                 else f"?{ostate}")
+        adm = [_gauge(lead, f"admitted_by_class{c}") or 0
+               for c in range(3)]
+        shd = [_gauge(lead, f"shed_by_class{c}") or 0
+               for c in range(3)]
+        offered = sum(adm) + sum(shd)
+        frac = (sum(shd) / offered) if offered else 0.0
+        lines.append(
+            f"  overload state={sname.upper() if ostate else sname} "
+            f"shed={_fmt(sum(shd), 0)} ({frac:.1%}) "
+            f"backoff={_fmt(_gauge(lead, 'overload_backoff_ms'), 0)}ms "
+            f"transitions="
+            f"{_fmt(_gauge(lead, 'overload_transitions'), 0)} "
+            f"fairness_sheds="
+            f"{_fmt(_gauge(lead, 'overload_fairness_sheds'), 0)}")
+        lines.append(
+            f"  {'class':<16s}{'admitted':>10s}{'shed':>10s}")
+        for c, label in enumerate(("drain (cxl/pay)", "admin",
+                                   "new orders")):
+            lines.append(f"  {label:<16s}{_fmt(adm[c], 0):>10s}"
+                         f"{_fmt(shd[c], 0):>10s}")
+
     lats = lead.get("metrics", {}).get("latencies", {})
     rows = [(s, lats.get(f"lat_{s}")) for s in STAGES]
     if any(v for _s, v in rows):

@@ -127,13 +127,26 @@ def reject_reason_codes(nmsg, msg_index, act, ok, cap_reject, host_rejects):
     return reasons
 
 
-def rej_record_json(oid: int, aid: int, code: int) -> str:
+def rej_record_json(oid: int, aid: int, code: int,
+                    detail: Optional[dict] = None) -> str:
     """The value of an opt-in "REJ"-keyed MatchOut annotation record
     (kme-serve --annotate-rejects): compact JSON naming the per-order
     reject cause. ADDITIVE — consumers keyed on IN/OUT are unaffected
-    and the default stream stays byte-identical to the reference."""
-    return (f'{{"oid":{oid},"aid":{aid},"reason":{code},'
+    and the default stream stays byte-identical to the reference.
+
+    `detail` appends extra keys in sorted order (rej_overload rows
+    carry the observed backlog, active threshold, degradation state and
+    backoff hint — the shed never reached the engine, so this record is
+    its only durable trace). Without detail the bytes are unchanged
+    from every prior release."""
+    base = (f'{{"oid":{oid},"aid":{aid},"reason":{code},'
             f'"rej":"{rej_name(code)}"}}')
+    if not detail:
+        return base
+    extra = ",".join(
+        f'"{k}":{json.dumps(detail[k], separators=(",", ":"))}'
+        for k in sorted(detail))
+    return base[:-1] + "," + extra + "}"
 
 
 @dataclasses.dataclass

@@ -120,6 +120,82 @@ def test_payout_storm_deterministic_with_bursts():
         assert a[i + 1].sid == abs(a[i].sid)
 
 
+def test_storm_profiles_deterministic_under_seed():
+    # same seed -> identical stream, for every named profile; a seed
+    # bump must move the stream (the chaos scenarios and the CI shed
+    # gate both depend on this)
+    from kme_tpu.workload import STORM_PROFILES, storm_stream
+
+    for name in STORM_PROFILES:
+        a = storm_stream(name, 800, num_symbols=8, num_accounts=16,
+                         seed=3)
+        b = storm_stream(name, 800, num_symbols=8, num_accounts=16,
+                         seed=3)
+        assert a == b, name
+        assert a != storm_stream(name, 800, num_symbols=8,
+                                 num_accounts=16, seed=4), name
+
+
+def test_storm_windows_cover_stream_and_scale():
+    from kme_tpu.workload import (STORM_PROFILES, storm_stream,
+                                  storm_windows)
+
+    for name in STORM_PROFILES:
+        msgs = storm_stream(name, 800, num_symbols=8, num_accounts=16,
+                            seed=0)
+        wins = storm_windows(name, 800, num_symbols=8, num_accounts=16)
+        assert wins, name
+        for lo, hi, mult in wins:
+            assert 0 <= lo < hi <= len(msgs), (name, lo, hi, len(msgs))
+            assert mult > 1, name
+
+
+def test_storm_profile_character():
+    from kme_tpu import opcodes as op
+    from kme_tpu.workload import storm_stream, storm_windows
+
+    # payout-storm-wide: one contiguous burst settling EVERY symbol
+    a = storm_stream("payout-storm-wide", 600, num_symbols=16,
+                     num_accounts=16, seed=1)
+    payouts = [i for i, m in enumerate(a) if m.action == op.PAYOUT]
+    assert len(payouts) == 16
+    assert payouts[-1] - payouts[0] == 2 * 15        # contiguous burst
+    (lo, hi, mult), = storm_windows("payout-storm-wide", 600,
+                                    num_symbols=16, num_accounts=16)
+    assert lo <= payouts[0] and payouts[-1] < hi
+
+    # cancel-storm: cancels dominate, mostly for bogus oids
+    c = storm_stream("cancel-storm", 2_000, num_symbols=8,
+                     num_accounts=16, seed=1)
+    cancels = [m for m in c if m.action == op.CANCEL]
+    assert len(cancels) > 0.6 * 2_000
+
+    # hot-book: one symbol carries nearly all the order flow
+    h = storm_stream("hot-book", 2_000, num_symbols=8,
+                     num_accounts=16, seed=1)
+    sub = collections.Counter(m.sid for m in h
+                              if m.action in (op.BUY, op.SELL))
+    assert sub[0] / sum(sub.values()) > 0.9
+
+    # liquidation-cascade: multiple full-universe settlement waves
+    lq = storm_stream("liquidation-cascade", 1_000, num_symbols=8,
+                      num_accounts=16, seed=1)
+    assert sum(1 for m in lq if m.action == op.PAYOUT) == 2 * 8
+
+
+def test_storm_profiles_survive_oracle():
+    # oracle-survival at small scale: every profile's full stream must
+    # process without crash, and fixed-mode solvency must hold
+    from kme_tpu.workload import STORM_PROFILES, storm_stream
+
+    for name in STORM_PROFILES:
+        e = OracleEngine("fixed")
+        for m in storm_stream(name, 600, num_symbols=8,
+                              num_accounts=16, seed=2):
+            e.process(m)
+        assert all(b >= 0 for b in e.balances.values()), name
+
+
 def test_adversarial_streams_survive_oracle():
     e = OracleEngine("fixed")
     for m in zipf_hot_stream(1_500, num_symbols=8, num_accounts=24,
