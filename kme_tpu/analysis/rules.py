@@ -56,8 +56,17 @@ HOT_SCOPES: Dict[str, Set[str]] = {
     # and are NOT listed, like the collect-side functions above
     "kme_tpu/parallel/seqmesh.py": {"plan_windows", "plan_rebalance"},
     # the front door's merge loop sits on the serving path of EVERY
-    # group's consumer — a blocking call here stalls the global feed
-    "kme_tpu/bridge/front.py": {"merge_records", "merge_streams"},
+    # group's consumer — a blocking call here stalls the global feed;
+    # accept_frames is the binary front door itself (one C call per
+    # batch — any blocking attr here re-taxes every ingress frame)
+    "kme_tpu/bridge/front.py": {"merge_records", "merge_streams",
+                                "accept_frames"},
+    # the binary produce path batches its durable write into ONE
+    # flush via _flush_log_lines (deliberately un-scoped: it is the
+    # sanctioned batched exit point) — per-record blocking I/O
+    # reappearing inside the loop is exactly the JSON-ingress tax
+    # this path exists to remove
+    "kme_tpu/bridge/broker.py": {"produce_frames"},
 }
 
 # Replay scopes: functions whose outputs must be bit-identical when a
@@ -71,6 +80,12 @@ REPLAY_SCOPES: Dict[str, Set[str]] = {
         "batch_events", "canonical_lines", "iter_events",
         "read_events"},
     "kme_tpu/bridge/broker.py": {"_load_topic"},
+    # the binary frame decoder feeds the broker's stored values (and
+    # therefore the durable log + oracle replay): it must re-decode a
+    # replayed buffer to bit-identical records, so no clock/RNG may
+    # leak into the walk
+    "kme_tpu/wire.py": {"decode_frame", "decode_frames",
+                        "_check_frame_header"},
     "kme_tpu/bridge/service.py": {"_init_exactly_once", "_try_resume",
                                   # cross-shard transfer routing: the
                                   # MatchOut/Xfer split and the stamp

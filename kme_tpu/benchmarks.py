@@ -1451,13 +1451,140 @@ def bench_storms(events: int = 4000, seed: int = 0,
     }
 
 
+def bench_wire(events: int = 20_000, seed: int = 0,
+               batch: int = 512, repeats: int = 3) -> dict:
+    """Binary-wire ingress suite (`--suite wire`): the SAME seeded
+    harness stream is driven into a real loopback kme TCP broker twice
+    at matched batching — once as JSON `produce_batch` rows (the
+    pre-PR-11 bulk path), once as 72-byte binary frames through
+    `produce_frames` — and the suite reports both ingress rates.
+    `ingress_msgs_per_sec` (binary, up-is-better) and `wire_parse_s`
+    (cumulative frame-decode wall for the timed binary run,
+    down-is-better) are perfgate-gated vs BASELINE_wire.json on CPU.
+
+    Parity is structural, not statistical: both modes must leave the
+    broker with BYTE-IDENTICAL stored values (the binary path decodes
+    to the canonical order_json before anything durable sees it), and
+    the stored stream replays through the Python oracle to identical
+    MatchOut lines — so the speedup can never come from changing what
+    gets admitted. The binary/JSON ratio is also asserted >= 1.5 on
+    CPU (the ISSUE's floor for the whole exercise)."""
+    import tempfile
+    import time
+
+    from kme_tpu.bridge import tcp as tcpmod
+    from kme_tpu.bridge.broker import InProcessBroker
+    from kme_tpu.oracle import OracleEngine
+    from kme_tpu.wire import dumps_order, encode_frames, parse_order
+    from kme_tpu.workload import harness_stream
+
+    msgs = harness_stream(events, seed=seed, num_accounts=64,
+                          num_symbols=16, validate=True)
+    n = len(msgs)
+    lines = [dumps_order(m) for m in msgs]
+    chunks = [msgs[lo:lo + batch] for lo in range(0, n, batch)]
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        broker = InProcessBroker(persist_dir=td)
+        srv, _ = tcpmod.serve_broker(port=0, broker=broker)
+        host, port = srv.server_address
+        cli = tcpmod.TcpBroker(host, port)
+        runs = {"json": [], "binary": []}
+        parse_s = None
+        stored = {}
+        try:
+            for rep in range(repeats):
+                for mode in ("json", "binary"):
+                    topic = f"wire_{mode}_r{rep}"
+                    cli.create_topic(topic)
+                    pns0 = broker.wire_parse_ns
+                    t1 = time.perf_counter()
+                    if mode == "json":
+                        for ch in chunks:
+                            cli.produce_batch(
+                                topic,
+                                [(None, dumps_order(m)) for m in ch])
+                    else:
+                        for ch in chunks:
+                            cli.produce_frames(topic, None,
+                                               encode_frames(ch))
+                    dt = time.perf_counter() - t1
+                    assert broker.end_offset(topic) == n, (
+                        f"{mode} ingress lost records: "
+                        f"{broker.end_offset(topic)} != {n}")
+                    runs[mode].append(dt)
+                    if mode == "binary" and (parse_s is None
+                                             or dt <= min(runs["binary"])):
+                        parse_s = (broker.wire_parse_ns - pns0) / 1e9
+                    if rep == 0:
+                        vals = []
+                        off = 0
+                        while off < n:
+                            recs = broker.fetch(topic, off, 4096)
+                            vals.extend(r.value for r in recs)
+                            off = recs[-1].offset + 1
+                        stored[mode] = vals
+        finally:
+            cli.close()
+            srv.shutdown()
+    # byte parity: the encoding must be invisible past admission
+    assert stored["json"] == stored["binary"], (
+        "binary ingress altered the stored record bytes")
+    oracle_out = {}
+    for mode, vals in stored.items():
+        eng = OracleEngine("fixed")
+        out = []
+        for v in vals:
+            out.extend(eng.process(parse_order(v)))
+        oracle_out[mode] = out
+    assert oracle_out["json"] == oracle_out["binary"], (
+        "oracle replay diverged between ingress encodings")
+    json_s = min(runs["json"])
+    bin_s = min(runs["binary"])
+    json_mps = n / json_s
+    bin_mps = n / bin_s
+    speedup = bin_mps / json_mps
+    import jax
+
+    backend = jax.default_backend()
+    if backend == "cpu" and speedup < 1.5:
+        raise AssertionError(
+            f"binary ingress speedup {speedup:.2f}x < 1.5x floor "
+            f"(json {json_mps:,.0f} msg/s, binary {bin_mps:,.0f} msg/s)")
+    elapsed = time.perf_counter() - t0
+    detail = {
+        "suite": "wire", "events": events, "records": n,
+        "seed": seed, "batch": batch, "repeats": repeats,
+        "backend": backend,
+        "elapsed_s": round(elapsed, 3),
+        "json_s": round(json_s, 4), "binary_s": round(bin_s, 4),
+        "json_msgs_per_sec": round(json_mps, 1),
+        "speedup_binary": round(speedup, 3),
+        "oracle_out_lines": len(oracle_out["binary"]),
+        # gated metrics (perfgate reads the detail root)
+        "ingress_msgs_per_sec": round(bin_mps, 1),
+        "wire_parse_s": round(parse_s, 6),
+    }
+    print(f"kme-bench wire: json={json_mps:,.0f} msg/s "
+          f"binary={bin_mps:,.0f} msg/s ({speedup:.2f}x) "
+          f"parse={parse_s:.4f}s ({elapsed:.1f}s)", file=sys.stderr)
+    return {
+        "metric": "ingress_msgs_per_sec",
+        "value": round(bin_mps, 1),
+        "unit": "msgs/sec",
+        "vs_baseline": round(bin_mps / REFERENCE_BASELINE_OPS, 3),
+        "detail": detail,
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
     p = argparse.ArgumentParser(prog="kme-bench")
     p.add_argument("--suite", choices=("lanes", "parity", "native",
                                        "latency", "pipeline",
-                                       "shards", "groups", "storms"),
+                                       "shards", "groups", "storms",
+                                       "wire"),
                    default="lanes")
     p.add_argument("--pipeline", type=int, default=2, metavar="N",
                    help="pipeline suite: in-flight batch window depth "
@@ -1618,6 +1745,9 @@ def main(argv=None) -> int:
                            max_fills=args.max_fills)
     elif args.suite == "storms":
         rec = bench_storms(args.events or 4000, seed=args.seed)
+    elif args.suite == "wire":
+        rec = bench_wire(args.events or 20_000, seed=args.seed,
+                         batch=max(args.batch, 1))
     elif args.suite == "latency":
         rec = bench_latency(args.events or 20_000, args.symbols,
                             args.accounts, args.seed, args.zipf,

@@ -139,6 +139,19 @@ def classify_produce(value: str):
     return _CLS_BY_ACTION.get(action, CLS_ORDER), oid, aid
 
 
+def classify_actions(actions):
+    """Vectorized _CLS_BY_ACTION over an int action column — the binary
+    produce path's classifier (frames already carry decoded columns, so
+    admission never touches JSON there). int8 class per row."""
+    import numpy as np
+
+    acts = np.asarray(actions)
+    out = np.full(len(acts), CLS_ORDER, np.int8)
+    for a, c in _CLS_BY_ACTION.items():
+        out[acts == a] = c
+    return out
+
+
 class OverloadController:
     """Degradation state machine with hysteresis + priority admission.
 
@@ -282,6 +295,14 @@ class OverloadController:
     def admit(self, value: str, backlog: int):
         """One admission decision: (True, None) or (False, detail)."""
         cls, oid, aid = classify_produce(value)
+        return self.admit_classified(cls, oid, aid, backlog)
+
+    def admit_classified(self, cls: int, oid: int, aid: int,
+                         backlog: int):
+        """admit() with the (class, oid, aid) triple already known —
+        the binary produce path classifies whole batches from the
+        decoded action column (classify_actions) and never pays a
+        json.loads per record. Same decisions, same counters."""
         self._update_state(backlog)
         if self.state == self.NORMAL:
             self.admitted_by_class[cls] += 1
@@ -365,6 +386,16 @@ def simulate_overload(values: List[str], windows, controller:
             "controller": controller.snapshot()}
 
 
+def _flush_log_lines(logfile, lines: List[str]) -> None:
+    """The batched durable-write exit point for produce_frames: ONE
+    write + flush for a whole admitted prefix. Deliberately outside
+    the produce_frames lint hot-scope — this is the sanctioned place
+    for the blocking I/O, so anything blocking reappearing inside the
+    per-record loop fails KME-H001."""
+    logfile.write("".join(lines))
+    logfile.flush()
+
+
 class InProcessBroker:
     """The broker API the rest of the bridge codes against. The TCP
     client (tcp.TcpBroker) implements the same three methods."""
@@ -385,6 +416,15 @@ class InProcessBroker:
         self._max_lag = max_lag
         self._commits: Dict[str, int] = {}
         self.overload_rejects = 0
+        # ingress encoding mix + decode cost. JSON produces count only
+        # on admission-bounded topics (a committed watermark marks a
+        # topic as ingress — MatchOut publishes are never counted);
+        # produce_frames is definitionally ingress and always counts.
+        # Feeds the wire_binary_frac / parse_ns_per_msg gauges
+        # (service).
+        self.wire_binary_records = 0
+        self.wire_json_records = 0
+        self.wire_parse_ns = 0
         # adaptive overload control: an OverloadController makes the
         # shed decision priority-aware (same arming rule as max_lag —
         # only topics with a committed watermark are bounded). The
@@ -493,13 +533,19 @@ class InProcessBroker:
 
     def produce(self, topic: str, key: Optional[str], value: str,
                 epoch: Optional[int] = None,
-                out_seq: Optional[int] = None) -> int:
+                out_seq: Optional[int] = None,
+                ats: Optional[int] = None) -> int:
         """Append one record; returns its offset. With an
         ``(epoch, out_seq)`` stamp the append is fenced and idempotent:
         a stale epoch raises BrokerFenced, and an ``out_seq`` at or
         below the topic's durable watermark is suppressed (returns -1,
         nothing appended) — replayed tails after a crash vanish here
-        instead of surfacing to consumers."""
+        instead of surfacing to consumers.
+
+        ``ats`` overrides the admission stamp (microseconds): remote
+        producers stamp at their FIRST send attempt and re-send the
+        same stamp across reconnects, so latency histograms include the
+        reconnect delay instead of hiding it (coordinated omission)."""
         if faults.should("broker.produce"):
             raise BrokerError("injected fault: broker.produce")
         with self._data:
@@ -532,12 +578,16 @@ class InProcessBroker:
                     self.overload_rejects += 1
             if shed_detail is None:
                 off = len(t.log)
-                import time as _time
+                if ats is None:
+                    import time as _time
 
+                    ats = _time.time_ns() // 1000
                 t.log.append(Record(off, key, value, epoch, out_seq,
-                                    _time.time_ns() // 1000))
+                                    ats))
                 if out_seq is not None:
                     t.max_out_seq = out_seq
+                if topic in self._commits:
+                    self.wire_json_records += 1
                 if t.logfile is not None:
                     row = ([key, value]
                            if epoch is None and out_seq is None
@@ -563,6 +613,127 @@ class InProcessBroker:
             f"(adaptive shed, backoff {shed_detail['backoff_ms']} ms)")
         exc.backoff_ms = shed_detail["backoff_ms"]
         exc.detail = shed_detail
+        raise exc
+
+    def produce_frames(self, topic: str, key: Optional[str], buf: bytes,
+                       epoch: Optional[int] = None,
+                       seq0: Optional[int] = None,
+                       ats: Optional[int] = None):
+        """Binary batch append: one contiguous buffer of 72-byte wire
+        frames (wire.py layout) -> records, without materializing a
+        Python dict per record. The frames decode ONCE (native
+        kme_parse_frames + the pinned kme_parse_emit emitter when
+        available) into the canonical order_json values the broker
+        always stores — the durable log, oracle replay, and MatchOut
+        bytes cannot tell which encoding carried a record. Admission
+        control classifies straight off the decoded action column
+        (classify_actions + admit_classified): no JSON anywhere on the
+        path.
+
+        Fencing/idempotence mirror produce(): with `epoch`/`seq0`,
+        record i carries out_seq seq0+i and duplicates are suppressed
+        individually. `ats` stamps the WHOLE batch (default: now).
+
+        Returns (n_appended, last_offset). On a mid-batch refusal
+        (max_lag or controller shed) the admitted prefix STAYS
+        appended — identical to a producer looping produce() — and the
+        raised BrokerOverload carries `.admitted` (records kept) plus
+        the usual backoff hint, so binary producers resume from
+        buf[admitted*72:] after backing off. Malformed frames raise
+        wire.WireFrameError (rej_malformed class) with NOTHING
+        appended — validation happens before admission."""
+        if faults.should("broker.produce"):
+            raise BrokerError("injected fault: broker.produce")
+        import time as _time
+
+        from kme_tpu import wire as _wire
+
+        t0 = _time.perf_counter_ns()
+        wb, values = _wire.frames_to_values(buf)
+        cls_col = classify_actions(wb.action)
+        oid_col, aid_col = wb.oid, wb.aid
+        parse_ns = _time.perf_counter_ns() - t0
+        if ats is None:
+            ats = _time.time_ns() // 1000
+        appended, last_off = 0, -1
+        shed_detail = overload_msg = None
+        with self._data:
+            self.wire_parse_ns += parse_ns
+            t = self._topics.get(topic)
+            if t is None:
+                raise BrokerError(f"unknown topic {topic!r}")
+            if epoch is not None:
+                if epoch < self._fence_epoch:
+                    self.fenced_produces += 1
+                    raise BrokerFenced(
+                        f"fenced: produce to {topic!r} from stale epoch "
+                        f"{epoch} < fence {self._fence_epoch}")
+                self._fence_epoch = epoch
+            bounded = topic in self._commits
+            lines: List[str] = []
+            for i in range(wb.n):
+                out_seq = None if seq0 is None else seq0 + i
+                if out_seq is not None and out_seq <= t.max_out_seq:
+                    self.dup_suppressed += 1
+                    continue
+                backlog = (len(t.log) - self._commits[topic]
+                           if bounded else 0)
+                if (self._max_lag is not None and bounded
+                        and backlog >= self._max_lag):
+                    self.overload_rejects += 1
+                    overload_msg = (
+                        f"rej_overload: topic {topic!r} backlog "
+                        f"{backlog} >= max_lag {self._max_lag}")
+                    break
+                if self.overload is not None and bounded:
+                    ok, shed_detail = self.overload.admit_classified(
+                        int(cls_col[i]), int(oid_col[i]),
+                        int(aid_col[i]), backlog)
+                    if not ok:
+                        self.overload_rejects += 1
+                        break
+                off = len(t.log)
+                t.log.append(Record(off, key, values[i], epoch, out_seq,
+                                    ats))
+                if out_seq is not None:
+                    t.max_out_seq = out_seq
+                if t.logfile is not None:
+                    row = ([key, values[i]]
+                           if epoch is None and out_seq is None
+                           else [key, values[i], epoch, out_seq])
+                    lines.append(json.dumps(row, separators=(",", ":"))
+                                 + "\n")
+                appended += 1
+                last_off = off
+            if lines:
+                # ONE write + flush for the whole admitted prefix (the
+                # per-record flush in produce() is the other half of
+                # the JSON ingress tax). A torn tail still repairs:
+                # partial writes are prefixes, so only the final line
+                # can be incomplete — exactly what _load_topic fixes.
+                _flush_log_lines(t.logfile, lines)
+            if appended:
+                self.wire_binary_records += appended
+                self._data.notify_all()
+        if overload_msg is None and shed_detail is None:
+            return appended, last_off
+        if shed_detail is not None:
+            obs = self.shed_observer
+            if obs is not None:
+                try:
+                    obs(topic, shed_detail)
+                except Exception:
+                    pass    # observability must never mask the shed
+            exc = BrokerOverload(
+                f"rej_overload: topic {topic!r} backlog "
+                f"{shed_detail['backlog']} state {shed_detail['state']} "
+                f"(adaptive shed, backoff {shed_detail['backoff_ms']} "
+                f"ms)")
+            exc.backoff_ms = shed_detail["backoff_ms"]
+            exc.detail = shed_detail
+        else:
+            exc = BrokerOverload(overload_msg)
+        exc.admitted = appended
         raise exc
 
     def fence(self, epoch: int) -> None:

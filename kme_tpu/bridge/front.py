@@ -150,6 +150,91 @@ def account_group(aid: int, ngroups: int) -> int:
     return group_of(aid, ngroups, SALT_ACCOUNT)
 
 
+def accept_routes(action, oid, aid, sid, ngroups: int):
+    """Vectorized stateless group routing over batch columns: account
+    ops (CREATE/TRANSFER) by aid under SALT_ACCOUNT; CANCEL by oid and
+    everything else by symbol_key(sid) under SALT_SYMBOL — the same
+    bucket route_line uses for records it has no per-oid state for, so
+    duplicates and replays land identically. Returns int32 group ids.
+    This is the semantics authority for the native acceptor's routing
+    select (kme_front.cpp)."""
+    import numpy as np
+
+    action = np.asarray(action)
+    keys = np.where(
+        action == op.CANCEL, oid,
+        np.where(np.asarray(sid) == _INT64_MIN, sid, np.abs(sid)))
+    gsym = assign_groups(keys, ngroups, SALT_SYMBOL)
+    gacct = assign_groups(np.ascontiguousarray(aid, np.int64), ngroups,
+                          SALT_ACCOUNT)
+    return np.where((action == op.CREATE_BALANCE)
+                    | (action == op.TRANSFER), gacct, gsym).astype(
+                        np.int32)
+
+
+def accept_frames(buf: bytes, ngroups: int, router=None, B: int = 0):
+    """The front door: one buffer of binary order frames -> (WireBatch,
+    int32 group route per row, plan-or-None), taking the GIL once per
+    batch. With the native library this is a single kme_front_accept
+    call that validates, decodes, group-routes and — when `router` (a
+    NativeSeqRouter) is given — chains kme_plan_batch to pack the
+    (K, B) scan planes in the same call; `plan` is then the
+    (cols, host_rejects, stacked, cnts, K) tuple with
+    sched.plan_batch's exact contract. Without the library the
+    byte-exact fallback is parse_frames + accept_routes (plan comes
+    back None and callers use their numpy plan path, as everywhere
+    else). Raises wire.WireFrameError on the first invalid frame —
+    always through the Python authority, so native and fallback
+    surface identical errors."""
+    import numpy as np
+
+    from kme_tpu.native import load_library
+    from kme_tpu.wire import WireBatch, decode_frames
+
+    lib = load_library()
+    if lib is None:
+        wb = WireBatch.parse_frames(buf)
+        return wb, accept_routes(wb.action, wb.oid, wb.aid, wb.sid,
+                                 ngroups), None
+    pack = rh = None
+    if router is not None:
+        from kme_tpu.native import sched as _sched
+
+        pack, rh = _sched.ensure_pack(router), router._h
+    h = lib.kme_front_new()
+    try:
+        rc = lib.kme_front_accept(h, buf, len(buf), ngroups,
+                                  SALT_SYMBOL, SALT_ACCOUNT, pack, rh,
+                                  B)
+        if rc < 0:
+            decode_frames(buf)  # raises the authoritative error
+            raise AssertionError(
+                "native rejected a buffer the authority accepts "
+                f"(code {rc} at offset {lib.kme_front_err_off(h)})")
+        n = int(rc)
+        if n == 0:
+            return WireBatch._empty(), np.zeros(0, np.int32), None
+        cols = [np.ctypeslib.as_array(
+            lib.kme_front_col(h, i), (n,)).copy() for i in range(8)]
+        hnext = np.ctypeslib.as_array(lib.kme_front_hnext(h),
+                                      (n,)).copy()
+        hprev = np.ctypeslib.as_array(lib.kme_front_hprev(h),
+                                      (n,)).copy()
+        wb = WireBatch(n, cols, hnext, hprev)
+        groups = np.ctypeslib.as_array(lib.kme_front_groups(h),
+                                       (n,)).copy()
+        plan = None
+        if router is not None:
+            from kme_tpu.native import sched as _sched
+
+            plan = _sched.collect_plan(lib, router, pack,
+                                       int(lib.kme_front_plan_k(h)), B,
+                                       wb.price, wb.size)
+        return wb, groups, plan
+    finally:
+        lib.kme_front_free(h)
+
+
 def make_internal_transfer(aid: int, amount: int, xid: int) -> str:
     """One leg of a reserve→settle pair: an ordinary TRANSFER wire line
     carrying the internal marker (prev) and the deterministic transfer

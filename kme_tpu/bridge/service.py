@@ -1076,6 +1076,20 @@ class MatchService:
         shed = getattr(self.broker, "overload_rejects", None)
         if shed is not None:
             t.gauge("overload_rejects").set(shed)
+        nbin = getattr(self.broker, "wire_binary_records", None)
+        if nbin is not None:
+            # binary-wire adoption surface (kme-top shows a wire row
+            # keyed on wire_binary_frac being present)
+            njson = self.broker.wire_json_records
+            total = nbin + njson
+            t.gauge("wire_binary_frac",
+                    "fraction of ingress records that arrived as "
+                    "binary wire frames").set(
+                round(nbin / total, 6) if total else 0.0)
+            t.gauge("parse_ns_per_msg",
+                    "mean wire-frame decode cost per binary "
+                    "record (ns)").set(
+                round(self.broker.wire_parse_ns / nbin) if nbin else 0)
         ctl = getattr(self.broker, "overload", None)
         if ctl is not None:
             # adaptive-controller surface (kme-top shows a degradation

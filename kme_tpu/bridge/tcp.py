@@ -21,15 +21,37 @@ Exactly-once produces additionally carry "epoch" and "out_seq" keys
 for stamped records come back as [o,k,v,epoch,out_seq], and rows whose
 record carries a broker-admission timestamp append a sixth element:
 [o,k,v,epoch,out_seq,ats] (microseconds, wall clock). Clients parse by
-length, so old/new peers interoperate.
+length, so old/new peers interoperate. Produce requests may carry an
+"ats" admission stamp: the client stamps at its FIRST send attempt and
+re-sends the same stamp when it retries the same record across a
+reconnect, so ingress latency histograms include the reconnect delay
+(coordinated-omission-safe) instead of restarting the clock.
+
+**Binary framing (additive, auto-negotiated per message).** The server
+peeks one byte per request: '{' (0x7B) opens the JSON line above;
+0xB1 (wire.WIRE_MAGIC) opens a binary PRODUCE envelope — the 8-byte
+frame header (magic, version, kind=FRAME_PRODUCE, flags, u32 body
+length) followed by u16 topic-length + topic, u8 key-length (255 =
+null) + key, three i64s (epoch, seq0, ats; INT64_MIN = absent), then
+the 72-byte order frames themselves. The reply is the usual JSON line
+({"ok":true,"n":N,"last_offset":O}); overload replies add "admitted"
+(records kept before the shed) so binary producers resume from
+buf[admitted*72:]. `fetch_bin` is the symmetric read path: a JSON
+request, answered by a JSON header line ({"ok":true,"n":N,
+"nbytes":B}) followed by B bytes of fixed-width rows — per record
+i64 offset/epoch/out_seq/ats (INT64_MIN = absent), u8 key-length
+(255 = null) + key, u32 value-length + value. Both paths carry the
+(epoch, out_seq) stamps and ats without a per-record dict on either
+side; JSON stays fully supported on the same socket (COMPAT.md).
 
 Errors come back as {"ok":false,"error":"..."}; the client raises
 BrokerError (BrokerOverload when the reply carries
 "code":"rej_overload" — the bounded-ingress shed; BrokerFenced for
 "code":"fenced" — a stale-epoch produce, which callers must treat as
-fatal, not retryable). `serve_broker` hosts an InProcessBroker for any
-number of concurrent client connections (thread per connection — the
-broker core is already thread-safe).
+fatal, not retryable; malformed binary frames carry
+"code":"rej_malformed" and raise ValueError). `serve_broker` hosts an
+InProcessBroker for any number of concurrent client connections
+(thread per connection — the broker core is already thread-safe).
 """
 
 from __future__ import annotations
@@ -37,13 +59,32 @@ from __future__ import annotations
 import json
 import socket
 import socketserver
+import struct
 import threading
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from kme_tpu import faults
 from kme_tpu.bridge.broker import (BrokerError, BrokerFenced,
                                    BrokerOverload, InProcessBroker,
                                    Record)
+from kme_tpu.wire import (FRAME_PRODUCE, WIRE_MAGIC, WIRE_VERSION,
+                          WireFrameError, rej_name)
+
+# binary envelope scaffolding (layout documented in the module
+# docstring; the 8-byte header is wire.py's frame header)
+_ENV_HDR = struct.Struct("<BBBBI")
+_ENV_META = struct.Struct("<qqq")       # epoch, seq0, ats
+_REC_HDR = struct.Struct("<qqqq")       # offset, epoch, out_seq, ats
+_I64_NONE = -(1 << 63)                  # "absent" for optional i64s
+_MAGIC_BYTE = bytes([WIRE_MAGIC])
+
+
+def _opt(v: Optional[int]) -> int:
+    return _I64_NONE if v is None else int(v)
+
+
+def _unopt(v: int) -> Optional[int]:
+    return None if v == _I64_NONE else v
 
 
 def _row(r: Record) -> list:
@@ -59,62 +100,86 @@ def _row(r: Record) -> list:
 
 
 class _Handler(socketserver.StreamRequestHandler):
+    def _read_exact(self, n: int) -> bytes:
+        data = self.rfile.read(n)
+        if len(data) != n:        # client died mid-frame
+            raise ConnectionResetError("short read inside binary frame")
+        return data
+
+    def _produce_frames_req(self, broker: InProcessBroker) -> dict:
+        """Binary PRODUCE envelope: the magic byte was already consumed
+        by the dispatch peek; read the rest of the 8-byte header, then
+        the declared body, and hand the raw frames to the broker without
+        building per-record dicts."""
+        hdr = _MAGIC_BYTE + self._read_exact(_ENV_HDR.size - 1)
+        _magic, version, kind, _flags, length = _ENV_HDR.unpack(hdr)
+        body = self._read_exact(length) if length else b""
+        # envelope validation mirrors wire.py's frame-validation order
+        if version != WIRE_VERSION:
+            raise WireFrameError("version_skew",
+                                 f"envelope version {version}, "
+                                 f"expected {WIRE_VERSION}")
+        if kind != FRAME_PRODUCE:
+            raise WireFrameError("bad_kind", f"envelope kind {kind}")
+        off = 2
+        if len(body) < off:
+            raise WireFrameError("truncated", "envelope shorter than "
+                                 "its topic-length field")
+        (tlen,) = struct.unpack_from("<H", body, 0)
+        if len(body) < off + tlen + 1:
+            raise WireFrameError("truncated", "envelope topic/key header")
+        topic = body[off:off + tlen].decode("utf-8", "replace")
+        off += tlen
+        klen = body[off]
+        off += 1
+        key: Optional[str] = None
+        if klen != 255:
+            if len(body) < off + klen:
+                raise WireFrameError("truncated", "envelope key")
+            key = body[off:off + klen].decode("utf-8", "replace")
+            off += klen
+        if len(body) < off + _ENV_META.size:
+            raise WireFrameError("truncated", "envelope epoch/seq/ats")
+        epoch, seq0, ats = _ENV_META.unpack_from(body, off)
+        off += _ENV_META.size
+        n, last = broker.produce_frames(
+            topic, key, body[off:], epoch=_unopt(epoch),
+            seq0=_unopt(seq0), ats=_unopt(ats))
+        return {"ok": True, "n": n, "last_offset": last}
+
     def handle(self) -> None:
         broker: InProcessBroker = self.server.broker  # type: ignore
-        for raw in self.rfile:
+        while True:
             try:
-                req = json.loads(raw)
-                op = req.get("op")
-                if op == "create_topic":
-                    created = broker.create_topic(
-                        req["topic"], int(req.get("partitions", 1)))
-                    resp = {"ok": True, "created": created}
-                elif op == "topics":
-                    resp = {"ok": True, "topics": broker.topics()}
-                elif op == "produce":
-                    off = broker.produce(req["topic"], req.get("key"),
-                                         req["value"],
-                                         epoch=req.get("epoch"),
-                                         out_seq=req.get("out_seq"))
-                    resp = {"ok": True, "offset": off}
-                elif op == "produce_batch":
-                    # one round trip for a whole record batch — the bulk
-                    # seeding path (kme-loadgen)
-                    off = -1
-                    for rec in req["records"]:
-                        off = broker.produce(
-                            req["topic"], rec[0], rec[1],
-                            epoch=rec[2] if len(rec) > 2 else None,
-                            out_seq=rec[3] if len(rec) > 3 else None)
-                    resp = {"ok": True, "last_offset": off}
-                elif op == "fetch":
-                    recs = broker.fetch(
-                        req["topic"], int(req["offset"]),
-                        int(req.get("max", 1024)),
-                        float(req.get("timeout_ms", 0)) / 1e3)
-                    # rows: [o,k,v] bare, [o,k,v,epoch,out_seq] stamped,
-                    # [o,k,v,epoch,out_seq,ats] with an admission stamp
-                    resp = {"ok": True, "records": [_row(r) for r in recs]}
-                elif op == "fence":
-                    broker.fence(int(req["epoch"]))
-                    resp = {"ok": True}
-                elif op == "end_offset":
-                    resp = {"ok": True,
-                            "offset": broker.end_offset(req["topic"])}
-                elif op == "commit":
-                    broker.commit(req["topic"], int(req["offset"]))
-                    resp = {"ok": True}
-                elif op == "sync":
-                    broker.sync()
-                    resp = {"ok": True}
+                first = self.rfile.read(1)
+            except (ConnectionResetError, OSError):
+                return
+            if not first:
+                return
+            tail = b""      # binary payload appended after the JSON line
+            try:
+                if first == _MAGIC_BYTE:
+                    resp = self._produce_frames_req(broker)
                 else:
-                    resp = {"ok": False, "error": f"unknown op {op!r}"}
+                    raw = first + self.rfile.readline()
+                    resp, tail = self._dispatch(broker, raw)
+            except ConnectionResetError:
+                return
+            except WireFrameError as e:
+                # malformed binary input is a clean protocol error, not
+                # a dropped connection — the stream stays in lockstep
+                # because the envelope header told us how much to read
+                resp = {"ok": False, "error": str(e),
+                        "code": rej_name(e.code)}
             except (BrokerOverload, BrokerFenced) as e:
                 resp = {"ok": False, "error": str(e), "code": e.code}
                 # AIMD producer backoff hint from the adaptive overload
                 # controller rides the rej_overload wire row
                 if getattr(e, "backoff_ms", None) is not None:
                     resp["backoff_ms"] = e.backoff_ms
+                # binary producers resume from buf[admitted*FRAME_SIZE:]
+                if getattr(e, "admitted", None) is not None:
+                    resp["admitted"] = e.admitted
             except BrokerError as e:
                 resp = {"ok": False, "error": str(e)}
             except (KeyError, ValueError, TypeError) as e:
@@ -122,6 +187,7 @@ class _Handler(socketserver.StreamRequestHandler):
             if faults.should("tcp.disconnect"):
                 return      # drop the connection without replying
             blob = (json.dumps(resp, separators=(",", ":")) + "\n").encode()
+            blob += tail
             if faults.should("tcp.partial"):
                 try:
                     self.wfile.write(blob[:max(1, len(blob) // 2)])
@@ -133,6 +199,77 @@ class _Handler(socketserver.StreamRequestHandler):
                 self.wfile.write(blob)
             except (BrokenPipeError, ConnectionResetError):
                 return
+
+    def _dispatch(self, broker: InProcessBroker,
+                  raw: bytes) -> Tuple[dict, bytes]:
+        """One JSON request -> (reply dict, binary tail). Broker/protocol
+        exceptions propagate to handle()'s shared error mapping."""
+        tail = b""
+        req = json.loads(raw)
+        op = req.get("op")
+        if op == "create_topic":
+            created = broker.create_topic(
+                req["topic"], int(req.get("partitions", 1)))
+            resp = {"ok": True, "created": created}
+        elif op == "topics":
+            resp = {"ok": True, "topics": broker.topics()}
+        elif op == "produce":
+            off = broker.produce(req["topic"], req.get("key"),
+                                 req["value"],
+                                 epoch=req.get("epoch"),
+                                 out_seq=req.get("out_seq"),
+                                 ats=req.get("ats"))
+            resp = {"ok": True, "offset": off}
+        elif op == "produce_batch":
+            # one round trip for a whole record batch — the bulk
+            # seeding path (kme-loadgen)
+            off = -1
+            for rec in req["records"]:
+                off = broker.produce(
+                    req["topic"], rec[0], rec[1],
+                    epoch=rec[2] if len(rec) > 2 else None,
+                    out_seq=rec[3] if len(rec) > 3 else None)
+            resp = {"ok": True, "last_offset": off}
+        elif op == "fetch":
+            recs = broker.fetch(
+                req["topic"], int(req["offset"]),
+                int(req.get("max", 1024)),
+                float(req.get("timeout_ms", 0)) / 1e3)
+            # rows: [o,k,v] bare, [o,k,v,epoch,out_seq] stamped,
+            # [o,k,v,epoch,out_seq,ats] with an admission stamp
+            resp = {"ok": True, "records": [_row(r) for r in recs]}
+        elif op == "fetch_bin":
+            recs = broker.fetch(
+                req["topic"], int(req["offset"]),
+                int(req.get("max", 1024)),
+                float(req.get("timeout_ms", 0)) / 1e3)
+            parts = []
+            for r in recs:
+                kb = b"" if r.key is None else r.key.encode()
+                vb = r.value.encode()
+                parts.append(
+                    _REC_HDR.pack(r.offset, _opt(r.epoch),
+                                  _opt(r.out_seq),
+                                  _opt(getattr(r, "ats", None)))
+                    + bytes([255 if r.key is None else len(kb)]) + kb
+                    + struct.pack("<I", len(vb)) + vb)
+            tail = b"".join(parts)
+            resp = {"ok": True, "n": len(recs), "nbytes": len(tail)}
+        elif op == "fence":
+            broker.fence(int(req["epoch"]))
+            resp = {"ok": True}
+        elif op == "end_offset":
+            resp = {"ok": True,
+                    "offset": broker.end_offset(req["topic"])}
+        elif op == "commit":
+            broker.commit(req["topic"], int(req["offset"]))
+            resp = {"ok": True}
+        elif op == "sync":
+            broker.sync()
+            resp = {"ok": True}
+        else:
+            resp = {"ok": False, "error": f"unknown op {op!r}"}
+        return resp, tail
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -171,6 +308,13 @@ class TcpBroker:
         self._lock = threading.Lock()
         self._sock = None
         self._rfile = None
+        # (fingerprint, ats) of the last produce that died on a transport
+        # fault: a retry of the SAME record reuses its original admission
+        # stamp, so the reconnect delay lands inside the latency
+        # histogram instead of restarting the clock (coordinated
+        # omission). Cleared on success, overload, and fence — those are
+        # broker verdicts, not transport faults.
+        self._pending: Optional[Tuple[tuple, int]] = None
         self._connect()
 
     def _connect(self) -> None:
@@ -193,15 +337,18 @@ class TcpBroker:
         finally:
             self._sock.close()
 
-    def _call(self, req: dict, extra_wait: float = 0.0) -> dict:
+    def _roundtrip(self, payload: bytes,
+                   extra_wait: float = 0.0) -> Tuple[dict, bytes]:
+        """Send one request frame (JSON line or binary envelope), read
+        the JSON reply line plus any binary tail the reply announces via
+        "nbytes". Returns (reply, tail)."""
         with self._lock:
             try:
                 if self._sock is None:
                     self._connect()
                 # read deadline covers the server's own blocking time
                 self._sock.settimeout(self._timeout + extra_wait)
-                self._sock.sendall(
-                    (json.dumps(req, separators=(",", ":")) + "\n").encode())
+                self._sock.sendall(payload)
                 raw = self._rfile.readline()
             except (socket.timeout, OSError) as e:
                 self._invalidate()
@@ -213,18 +360,48 @@ class TcpBroker:
             if not raw.endswith(b"\n"):
                 self._invalidate()
                 raise BrokerError("partial broker reply; connection closed")
-        resp = json.loads(raw)
+            resp = json.loads(raw)
+            body = b""
+            nbytes = resp.get("nbytes")
+            if resp.get("ok") and nbytes:
+                try:
+                    body = self._rfile.read(int(nbytes))
+                except (socket.timeout, OSError) as e:
+                    self._invalidate()
+                    raise BrokerError(
+                        f"broker call failed ({e}); connection closed") from e
+                if len(body) != int(nbytes):
+                    self._invalidate()
+                    raise BrokerError(
+                        "partial broker reply; connection closed")
         if not resp.get("ok"):
             err = resp.get("error", "unknown broker error")
             if resp.get("code") == BrokerOverload.code:
                 exc = BrokerOverload(err)
                 if resp.get("backoff_ms") is not None:
                     exc.backoff_ms = int(resp["backoff_ms"])
+                if resp.get("admitted") is not None:
+                    exc.admitted = int(resp["admitted"])
                 raise exc
             if resp.get("code") == BrokerFenced.code:
                 raise BrokerFenced(err)
+            if resp.get("code") == "rej_malformed":
+                raise ValueError(err)
             raise BrokerError(err)
-        return resp
+        return resp, body
+
+    def _call(self, req: dict, extra_wait: float = 0.0) -> dict:
+        payload = (json.dumps(req, separators=(",", ":")) + "\n").encode()
+        return self._roundtrip(payload, extra_wait)[0]
+
+    def _ats_for(self, fp: tuple) -> int:
+        """Admission stamp for a produce attempt: reuse the stamp of a
+        transport-faulted attempt at the SAME record, else stamp now."""
+        import time as _time
+        pend = self._pending
+        if pend is not None and pend[0] == fp:
+            return pend[1]
+        return _time.time_ns() // 1000
 
     def create_topic(self, name: str, partitions: int = 1) -> bool:
         return self._call({"op": "create_topic", "topic": name,
@@ -236,12 +413,51 @@ class TcpBroker:
     def produce(self, topic: str, key: Optional[str], value: str,
                 epoch: Optional[int] = None,
                 out_seq: Optional[int] = None) -> int:
-        req = {"op": "produce", "topic": topic, "key": key, "value": value}
+        fp = ("produce", topic, key, value, epoch, out_seq)
+        ats = self._ats_for(fp)
+        req = {"op": "produce", "topic": topic, "key": key, "value": value,
+               "ats": ats}
         if epoch is not None:
             req["epoch"] = epoch
         if out_seq is not None:
             req["out_seq"] = out_seq
-        return self._call(req)["offset"]
+        try:
+            off = self._call(req)["offset"]
+        except (BrokerOverload, BrokerFenced):
+            self._pending = None    # broker verdict, stamp expires
+            raise
+        except BrokerError:
+            self._pending = (fp, ats)   # transport fault: keep the stamp
+            raise
+        self._pending = None
+        return off
+
+    def produce_frames(self, topic: str, key: Optional[str], buf: bytes,
+                       epoch: Optional[int] = None,
+                       seq0: Optional[int] = None) -> Tuple[int, int]:
+        """Append a buffer of 72-byte binary order frames in one round
+        trip — no per-record dicts on either side. Returns (n appended,
+        last offset). On BrokerOverload the exception's `.admitted`
+        counts the prefix kept; resume from buf[admitted*FRAME_SIZE:]."""
+        fp = ("frames", topic, key, buf, epoch, seq0)
+        ats = self._ats_for(fp)
+        tb = topic.encode()
+        kb = b"" if key is None else key.encode()
+        body = (struct.pack("<H", len(tb)) + tb
+                + bytes([255 if key is None else len(kb)]) + kb
+                + _ENV_META.pack(_opt(epoch), _opt(seq0), ats) + buf)
+        payload = _ENV_HDR.pack(WIRE_MAGIC, WIRE_VERSION, FRAME_PRODUCE,
+                                0, len(body)) + body
+        try:
+            resp, _ = self._roundtrip(payload)
+        except (BrokerOverload, BrokerFenced):
+            self._pending = None    # broker verdict, stamp expires
+            raise
+        except BrokerError:
+            self._pending = (fp, ats)   # transport fault: keep the stamp
+            raise
+        self._pending = None
+        return resp["n"], resp["last_offset"]
 
     def produce_batch(self, topic: str, records) -> int:
         """Append [(key, value), ...] in one round trip; returns the last
@@ -259,6 +475,35 @@ class TcpBroker:
                        row[4] if len(row) > 4 else None,
                        row[5] if len(row) > 5 else None)
                 for row in resp["records"]]
+
+    def fetch_bin(self, topic: str, offset: int, max_records: int = 1024,
+                  timeout: float = 0.0) -> List[Record]:
+        """fetch() over the binary reply tail: one JSON header line, then
+        fixed-width rows — stamps and ats decode straight from bytes."""
+        resp, body = self._roundtrip(
+            (json.dumps({"op": "fetch_bin", "topic": topic,
+                         "offset": offset, "max": max_records,
+                         "timeout_ms": timeout * 1e3},
+                        separators=(",", ":")) + "\n").encode(),
+            extra_wait=timeout)
+        recs: List[Record] = []
+        off = 0
+        for _ in range(int(resp["n"])):
+            o, epoch, out_seq, ats = _REC_HDR.unpack_from(body, off)
+            off += _REC_HDR.size
+            klen = body[off]
+            off += 1
+            key = None
+            if klen != 255:
+                key = body[off:off + klen].decode()
+                off += klen
+            (vlen,) = struct.unpack_from("<I", body, off)
+            off += 4
+            value = body[off:off + vlen].decode()
+            off += vlen
+            recs.append(Record(o, key, value, _unopt(epoch),
+                               _unopt(out_seq), _unopt(ats)))
+        return recs
 
     def end_offset(self, topic: str) -> int:
         return self._call({"op": "end_offset", "topic": topic})["offset"]

@@ -35,7 +35,26 @@ def loadgen_main(argv=None) -> int:
     p.add_argument("--broker", default=None, metavar="HOST:PORT",
                    help="produce to MatchIn on this broker instead of "
                         "printing to stdout (the exchange_test.js role)")
+    p.add_argument("--connections", type=int, default=None, metavar="N",
+                   help="simulate N independent AIMD-paced clients "
+                        "multiplexed over --pool sockets (requires "
+                        "--broker); client i owns every N-th event")
+    p.add_argument("--binary", action="store_true",
+                   help="send 72-byte binary wire frames (produce_frames)"
+                        " instead of JSON records")
+    p.add_argument("--pool", type=int, default=4,
+                   help="real sockets backing the simulated clients")
+    p.add_argument("--client-batch", type=int, default=64,
+                   help="max records per simulated-client send")
+    p.add_argument("--epoch", type=int, default=1,
+                   help="producer epoch for exactly-once stamps "
+                        "(--connections mode stamps every record)")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="write a JSON run report (throughput, AIMD "
+                        "rates, observed backoff_ms decay)")
     args = p.parse_args(argv)
+    if args.connections is not None and args.broker is None:
+        p.error("--connections requires --broker")
     from kme_tpu.wire import dumps_order
     from kme_tpu.workload import harness_stream
 
@@ -44,6 +63,8 @@ def loadgen_main(argv=None) -> int:
                           num_symbols=args.symbols,
                           payout_opcode_bug=not args.fix_payout_opcode,
                           validate=args.validate)
+    if args.connections is not None:
+        return _loadgen_connections(args, msgs)
     if args.broker is not None:
         from kme_tpu.bridge.provision import provision
         from kme_tpu.bridge.service import TOPIC_IN
@@ -85,6 +106,156 @@ def loadgen_main(argv=None) -> int:
         return 0
     for m in msgs:
         print(dumps_order(m))
+    return 0
+
+
+def _loadgen_connections(args, msgs) -> int:
+    """--connections N: N simulated clients share --pool sockets, each
+    with its own AIMD pacer (additive rate increase on success,
+    multiplicative decrease on rej_overload, honoring the broker's
+    backoff_ms hint before the next send). Every record carries an
+    exactly-once (epoch, out_seq) stamp assigned at send time from one
+    global sequence, so transport-fault retries are dup-suppressed by
+    the broker and the admitted stream stays duplicate-free; a shed
+    batch resumes from the admitted prefix (.admitted on the binary
+    path, the per-record send count on the JSON path)."""
+    import json as _json
+    import time
+
+    import numpy as np
+
+    from kme_tpu.bridge.broker import (BrokerError, BrokerFenced,
+                                       BrokerOverload)
+    from kme_tpu.bridge.provision import provision
+    from kme_tpu.bridge.service import TOPIC_IN
+    from kme_tpu.bridge.tcp import TcpBroker, parse_addr
+    from kme_tpu.wire import dumps_order, encode_frames
+
+    host, port = parse_addr(args.broker)
+    ncli = max(1, args.connections)
+    pool = [TcpBroker(host, port)
+            for _ in range(max(1, min(args.pool, ncli)))]
+    transport_retries = 0
+
+    def call_rt(fn, *a, **kw):
+        # transport faults retry the SAME record/stamps immediately (the
+        # broker dedups by out_seq; TcpBroker preserves the ats stamp),
+        # broker verdicts (overload/fence) propagate to the pacer
+        nonlocal transport_retries
+        for _ in range(100):
+            try:
+                return fn(*a, **kw)
+            except (BrokerOverload, BrokerFenced):
+                raise
+            except BrokerError:
+                transport_retries += 1
+                time.sleep(0.01)
+        raise BrokerError("transport retry budget exhausted")
+
+    try:
+        provision(pool[0])
+        # client i owns msgs[i::ncli]; heads[] walks each queue
+        sizes = (len(msgs) - np.arange(ncli) + ncli - 1) // ncli
+        sizes = np.maximum(sizes, 0)
+        heads = np.zeros(ncli, dtype=np.int64)
+        remaining = sizes.copy()
+        rate = np.full(ncli, 1000.0)    # records/s; AI +10, MD x0.5
+        next_at = np.zeros(ncli)
+        next_seq = 0
+        sheds = dup = 0
+        backoff_samples = []
+        t0 = time.monotonic()
+        while True:
+            active = np.flatnonzero(remaining > 0)
+            if active.size == 0:
+                break
+            now = time.monotonic() - t0
+            due = active[next_at[active] <= now]
+            if due.size == 0:
+                time.sleep(max(1e-4,
+                               float(next_at[active].min()) - now))
+                continue
+            for ci in due:
+                ci = int(ci)
+                k = int(min(args.client_batch, remaining[ci]))
+                h = int(heads[ci])
+                batch = [msgs[ci + (h + j) * ncli] for j in range(k)]
+                cli = pool[ci % len(pool)]
+                seq0 = next_seq
+                sent = 0
+                now = time.monotonic() - t0
+                try:
+                    if args.binary:
+                        buf = encode_frames(batch)
+                        n, _ = call_rt(cli.produce_frames, TOPIC_IN,
+                                       None, buf, epoch=args.epoch,
+                                       seq0=seq0)
+                        dup += k - n    # transport-retry suppressions
+                        ok_n = k
+                    else:
+                        for m in batch:
+                            r = call_rt(cli.produce, TOPIC_IN, None,
+                                        dumps_order(m),
+                                        epoch=args.epoch,
+                                        out_seq=seq0 + sent)
+                            if r == -1:
+                                dup += 1
+                            sent += 1
+                        ok_n = k
+                except BrokerOverload as e:
+                    ok_n = ((getattr(e, "admitted", None) or 0)
+                            if args.binary else sent)
+                    sheds += 1
+                    hint = getattr(e, "backoff_ms", None)
+                    backoff_samples.append(
+                        [round(now, 4),
+                         None if hint is None else int(hint)])
+                    next_at[ci] = now + ((hint / 1e3) if hint else 0.1)
+                    rate[ci] = max(1.0, rate[ci] * 0.5)
+                else:
+                    rate[ci] = min(10000.0, rate[ci] + 10.0)
+                    next_at[ci] = now + k / rate[ci]
+                next_seq += ok_n
+                heads[ci] += ok_n
+                remaining[ci] -= ok_n
+        dur = time.monotonic() - t0
+    finally:
+        for cli in pool:
+            cli.close()
+    hints = [h for _, h in backoff_samples if h is not None]
+    mask = sizes > 0
+    report = {
+        "connections": ncli,
+        "events": len(msgs),
+        "binary": bool(args.binary),
+        "epoch": args.epoch,
+        "produced": int(next_seq),
+        "dup_suppressed": int(dup),
+        "sheds": int(sheds),
+        "transport_retries": int(transport_retries),
+        "duration_s": round(dur, 3),
+        "rate_rps": round(next_seq / dur, 1) if dur > 0 else None,
+        "aimd": {
+            "rate_mean": round(float(rate[mask].mean()), 1)
+            if mask.any() else None,
+            "rate_min": round(float(rate[mask].min()), 1)
+            if mask.any() else None,
+            "rate_max": round(float(rate[mask].max()), 1)
+            if mask.any() else None,
+        },
+        # the controller's AIMD hint should decay as pressure falls —
+        # the raw samples let CI (and humans) see the curve
+        "backoff_ms_samples": backoff_samples[:1000],
+        "backoff_ms_max": max(hints) if hints else None,
+        "backoff_ms_last": hints[-1] if hints else None,
+    }
+    if args.report:
+        with open(args.report, "w") as f:
+            _json.dump(report, f, indent=1)
+    print(f"kme-loadgen: {next_seq} records from {ncli} simulated "
+          f"clients ({'binary' if args.binary else 'json'}) in "
+          f"{dur:.2f}s, {sheds} sheds, {transport_retries} transport "
+          f"retries", file=sys.stderr)
     return 0
 
 

@@ -21,7 +21,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRCS = (os.path.join(_HERE, "kme_host.cpp"),
          os.path.join(_HERE, "kme_oracle.cpp"),
          os.path.join(_HERE, "kme_wire.cpp"),
-         os.path.join(_HERE, "kme_router.cpp"))
+         os.path.join(_HERE, "kme_router.cpp"),
+         os.path.join(_HERE, "kme_front.cpp"))
 
 _lib = None
 _lib_tried = False
@@ -262,6 +263,30 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "kme_parse_col": ([c.c_void_p, c.c_int32], P64),
         "kme_parse_hnext": ([c.c_void_p], c.POINTER(c.c_uint8)),
         "kme_parse_hprev": ([c.c_void_p], c.POINTER(c.c_uint8)),
+        # binary order frames + canonical-JSON emission (kme_wire.cpp)
+        "kme_parse_frames": ([c.c_void_p, c.c_char_p, c.c_int64],
+                             c.c_int64),
+        "kme_parse_err_off": ([c.c_void_p], c.c_int64),
+        "kme_parse_emit": ([c.c_void_p], c.c_int64),
+        "kme_parse_emit_buf": ([c.c_void_p], c.c_void_p),
+        "kme_parse_emit_off": ([c.c_void_p], P64),
+        # native front-door acceptor (kme_front.cpp): validate + route
+        # + plan in one call per batch
+        "kme_front_new": ([], c.c_void_p),
+        "kme_front_free": ([c.c_void_p], None),
+        "kme_front_accept": ([c.c_void_p, c.c_char_p, c.c_int64,
+                              c.c_int32, c.c_int64, c.c_int64,
+                              c.c_void_p, c.c_void_p, c.c_int32],
+                             c.c_int64),
+        "kme_front_groups": ([c.c_void_p], P32),
+        "kme_front_plan_k": ([c.c_void_p], c.c_int64),
+        "kme_front_err_off": ([c.c_void_p], c.c_int64),
+        "kme_front_col": ([c.c_void_p, c.c_int32], P64),
+        "kme_front_hnext": ([c.c_void_p], c.POINTER(c.c_uint8)),
+        "kme_front_hprev": ([c.c_void_p], c.POINTER(c.c_uint8)),
+        "kme_front_json": ([c.c_void_p], c.c_int64),
+        "kme_front_json_buf": ([c.c_void_p], c.c_void_p),
+        "kme_front_json_off": ([c.c_void_p], P64),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)

@@ -304,12 +304,30 @@ struct Parse {
   uint8_t* hnext = nullptr;
   uint8_t* hprev = nullptr;
   int64_t cap = 0, n = 0;
+  int64_t err_off = 0;       // byte offset of the frame that failed
+  Recon emit;                // canonical-JSON emission scratch
+  int64_t* emit_off = nullptr;  // n+1 line offsets into emit.buf
+  int64_t emit_off_cap = 0;
   ~Parse() {
     for (auto* c : cols) delete[] c;
     delete[] hnext;
     delete[] hprev;
+    delete[] emit_off;
   }
 };
+
+inline void parse_reserve(Parse& P, int64_t n) {
+  if (P.cap >= n) return;
+  for (auto*& c : P.cols) {
+    delete[] c;
+    c = new int64_t[n];
+  }
+  delete[] P.hnext;
+  delete[] P.hprev;
+  P.hnext = new uint8_t[n];
+  P.hprev = new uint8_t[n];
+  P.cap = n;
+}
 
 inline void skip_ws(const char*& p, const char* end) {
   while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) p++;
@@ -392,17 +410,7 @@ int64_t kme_parse_lines(void* handle, const char* buf, int64_t len) {
   for (int64_t i = 0; i < len; i++)
     if (buf[i] == '\n') nlines++;
   if (len > 0 && buf[len - 1] != '\n') nlines++;
-  if (P.cap < nlines) {
-    for (auto*& c : P.cols) {
-      delete[] c;
-      c = new int64_t[nlines];
-    }
-    delete[] P.hnext;
-    delete[] P.hprev;
-    P.hnext = new uint8_t[nlines];
-    P.hprev = new uint8_t[nlines];
-    P.cap = nlines;
-  }
+  parse_reserve(P, nlines);
   P.n = 0;
   const char* p = buf;
   const char* bend = buf + len;
@@ -501,6 +509,95 @@ int64_t kme_parse_lines(void* handle, const char* buf, int64_t len) {
     P.n++;
   }
   return P.n;
+}
+
+// ---------------------------------------------------------------------------
+// Binary order frames (wire.py layout authority): 72 bytes little-
+// endian — magic 0xB1, version, kind, flags, u32 length prefix, then
+// action/oid/aid/sid/price/size/next/prev as int64. Values are
+// memcpy'd (alignment-safe); the build targets little-endian hosts
+// only, same assumption the journal's binary framing already makes.
+
+int64_t kme_parse_err_off(void* p) {
+  return static_cast<Parse*>(p)->err_off;
+}
+
+// Parse `len` bytes of concatenated binary order frames into the same
+// columns kme_parse_lines fills. Returns the frame count, or a
+// negative validation code for the FIRST bad frame (offset readable
+// via kme_parse_err_off): -1 truncated, -2 bad magic, -3 version
+// skew, -4 bad kind, -5 bad length. Check order matches
+// wire._check_frame_header exactly — the Python caller re-raises
+// through the Python authority so the surfaced error is identical.
+int64_t kme_parse_frames(void* handle, const uint8_t* buf, int64_t len) {
+  constexpr int64_t FRAME_SIZE = 72, FRAME_HDR = 8;
+  Parse& P = *static_cast<Parse*>(handle);
+  parse_reserve(P, len / FRAME_SIZE + 1);
+  P.n = 0;
+  P.err_off = 0;
+  int64_t off = 0, i = 0;
+  while (off < len) {
+    P.err_off = off;
+    const uint8_t* b = buf + off;
+    int64_t rem = len - off;
+    if (rem < FRAME_HDR) return -1;
+    if (b[0] != 0xB1) return -2;
+    if (b[1] != 1) return -3;
+    if (b[2] != 0) return -4;
+    uint32_t length;
+    std::memcpy(&length, b + 4, 4);
+    if (length != FRAME_SIZE) return -5;
+    if (rem < FRAME_SIZE) return -1;
+    int64_t v[8];
+    std::memcpy(v, b + 8, 64);
+    for (int f = 0; f < 8; f++) P.cols[f][i] = v[f];
+    P.hnext[i] = b[3] & 1;
+    P.hprev[i] = (b[3] >> 1) & 1;
+    off += FRAME_SIZE;
+    i++;
+  }
+  P.n = i;
+  return i;
+}
+
+// Emit the canonical Jackson JSON line for every parsed row (the value
+// the broker stores — binary is transport-only, the durable log and
+// the oracle replay see order_json bytes regardless of encoding).
+// Lines are concatenated with NO separators; kme_parse_emit_off gives
+// n+1 offsets. Goes through put_order, the same emitter the byte-
+// pinned reconstruction uses, so encode parity is inherited.
+int64_t kme_parse_emit(void* handle) {
+  Parse& P = *static_cast<Parse*>(handle);
+  Recon& r = P.emit;
+  // worst case per line: 65 bytes of scaffolding + 8 fields of up to
+  // 20 chars (int64 min) = 225; 240 leaves slack
+  int64_t need = 240 * (P.n > 0 ? P.n : 1);
+  if (r.cap < need) {
+    delete[] r.buf;
+    r.buf = new char[need];
+    r.cap = need;
+  }
+  if (P.emit_off_cap < P.n + 1) {
+    delete[] P.emit_off;
+    P.emit_off = new int64_t[P.n + 1];
+    P.emit_off_cap = P.n + 1;
+  }
+  r.len = 0;
+  for (int64_t i = 0; i < P.n; i++) {
+    P.emit_off[i] = r.len;
+    put_order(r, P.cols[0][i], P.cols[1][i], P.cols[2][i], P.cols[3][i],
+              P.cols[4][i], P.cols[5][i], P.hnext[i] != 0, P.cols[6][i],
+              P.hprev[i] != 0, P.cols[7][i]);
+  }
+  P.emit_off[P.n] = r.len;
+  return r.len;
+}
+
+const char* kme_parse_emit_buf(void* p) {
+  return static_cast<Parse*>(p)->emit.buf;
+}
+const int64_t* kme_parse_emit_off(void* p) {
+  return static_cast<Parse*>(p)->emit_off;
 }
 
 }  // extern "C"

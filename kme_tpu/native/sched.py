@@ -246,14 +246,7 @@ def plan_batch(router, batch, B: int):
     lib = router._lib
     if not hasattr(lib, "kme_plan_batch"):
         return None
-    pack = getattr(router, "_pack", None)
-    if pack is None:
-        import weakref
-
-        pack = lib.kme_pack_new()
-        router._pack = pack
-        router._pack_fin = weakref.finalize(router, lib.kme_pack_free,
-                                            pack)
+    pack = ensure_pack(router)
     # kme_plan_batch reads batch.n int64s from every column with no
     # native-side length check: pin the dtype at conversion and verify
     # the element count BEFORE handing out pointers
@@ -268,12 +261,38 @@ def plan_batch(router, batch, B: int):
         *(raw[f].ctypes.data_as(P64)
           for f in ("action", "oid", "aid", "sid", "price", "size")),
         B))
+    return collect_plan(lib, router, pack, K, B, raw["price"],
+                        raw["size"])
+
+
+def ensure_pack(router):
+    """The router's cached native pack handle (kme_pack_new), created
+    on first use and freed with the router. Shared by plan_batch and
+    the front-door acceptor (bridge/front.py accept_frames), which
+    chains kme_plan_batch inside its single kme_front_accept call."""
+    lib = router._lib
+    pack = getattr(router, "_pack", None)
+    if pack is None:
+        import weakref
+
+        pack = lib.kme_pack_new()
+        router._pack = pack
+        router._pack_fin = weakref.finalize(router, lib.kme_pack_free,
+                                            pack)
+    return pack
+
+
+def collect_plan(lib, router, pack, K, B, price, size):
+    """Shared tail of the native plan: map the result code K to the
+    EnvelopeError/CapacityError contract and read back routed columns +
+    packed planes. `price`/`size` are the int64 input columns,
+    consulted only for the envelope error message."""
     if K == -3:
         i = int(lib.kme_pack_err_index(pack))
         raise EnvelopeError(
             f"message {i}: price/size outside int32 "
-            f"(price={int(raw['price'][i])}, "
-            f"size={int(raw['size'][i])})")
+            f"(price={int(price[i])}, "
+            f"size={int(size[i])})")
     if K < 0:
         raise CapacityError(
             f"{'account' if K == -1 else 'symbol'} capacity "

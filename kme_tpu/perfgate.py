@@ -71,6 +71,12 @@ GATED_METRICS = {
     "shed_frac_cancel_storm": "down",
     "shed_frac_hot_book": "down",
     "shed_frac_liquidation_cascade": "down",
+    # binary wire ingress (ISSUE r11): loopback-TCP binary produce rate
+    # and the frame-decode wall of the timed binary run — wall-clock
+    # metrics, so they gate on CPU baselines with the host-gate
+    # tolerance (BASELINE_wire.json)
+    "ingress_msgs_per_sec": "up",
+    "wire_parse_s": "down",
 }
 
 # reported-only: too noisy to gate on (documented flappers)

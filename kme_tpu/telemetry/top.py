@@ -196,6 +196,15 @@ def render(view: dict, width: int = 78) -> list:
             lines.append(f"  {label:<16s}{_fmt(adm[c], 0):>10s}"
                          f"{_fmt(shd[c], 0):>10s}")
 
+    # wire row (binary front door, kme-serve + produce_frames): only
+    # rendered when the leader publishes the binary-adoption gauge —
+    # absent on pre-binary leaders
+    wfrac = _gauge(lead, "wire_binary_frac")
+    if wfrac is not None:
+        lines.append(
+            f"  wire binary={wfrac:.1%} "
+            f"parse={_fmt(_gauge(lead, 'parse_ns_per_msg'), 0)}ns/msg")
+
     lats = lead.get("metrics", {}).get("latencies", {})
     rows = [(s, lats.get(f"lat_{s}")) for s in STAGES]
     if any(v for _s, v in rows):

@@ -29,6 +29,7 @@ MLIR -- throws an exception.)
 """
 
 import argparse
+import glob
 import hashlib
 import os
 import subprocess
@@ -37,9 +38,10 @@ import sys
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 NATIVE = os.path.join(REPO, "kme_tpu", "native")
-SRCS = [os.path.join(NATIVE, f) for f in
-        ("kme_host.cpp", "kme_oracle.cpp", "kme_wire.cpp",
-         "kme_router.cpp")]
+# every translation unit in the package, so a newly added source can
+# never be silently missing from the sanitized build (the runtime
+# loader in kme_tpu/native/__init__.py compiles the same set)
+SRCS = sorted(glob.glob(os.path.join(NATIVE, "kme_*.cpp")))
 
 BASE = ["-shared", "-fPIC", "-std=c++17"]
 SAN = ["-g", "-O1", "-fno-omit-frame-pointer",

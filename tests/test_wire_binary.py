@@ -1,0 +1,340 @@
+"""Binary wire protocol + native front door (ISSUE r11).
+
+The contract under test, layer by layer:
+
+- frame codec: encode_frames/decode_frames round-trip, and the batch
+  parser (native kme_parse_frames or the numpy fallback) agrees with
+  the scalar authority column-for-column;
+- acceptor: bridge/front.accept_frames routes every row exactly like
+  the numpy accept_routes authority (and the scalar group functions),
+  and its chained one-call plan equals sched.plan_batch's output;
+- broker: produce_frames stores records byte-identical to a loop of
+  produce() over the same stream — stamps, ats, dup suppression,
+  admission classes and the admitted prefix under a mid-batch refusal;
+- transport: the binary PRODUCE envelope and fetch_bin round-trip over
+  a real socket, JSON and binary interleave on one connection, and the
+  client's admission stamp survives a reconnect retry (the
+  coordinated-omission fix).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kme_tpu import faults, wire
+from kme_tpu.bridge.broker import (BrokerError, BrokerOverload,
+                                   InProcessBroker)
+from kme_tpu.bridge.tcp import TcpBroker, serve_broker
+
+
+def _msgs(n, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        out.append(wire.OrderMsg(
+            action=int(rng.choice([0, 1, 2, 3, 4, 100, 101, 200])),
+            oid=i + 1, aid=int(rng.integers(1, 64)),
+            sid=int(rng.integers(-4, 9)),
+            price=int(rng.integers(1, 1000)),
+            size=int(rng.integers(1, 10)),
+            next=None if i % 3 else i + 2,
+            prev=None if i % 5 else -i))
+    return out
+
+
+def test_frame_roundtrip_and_batch_parity():
+    msgs = _msgs(64)
+    buf = wire.encode_frames(msgs)
+    assert len(buf) == 64 * wire.FRAME_SIZE
+    assert wire.decode_frames(buf) == msgs
+    wb = wire.WireBatch.parse_frames(buf)
+    for i, m in enumerate(msgs):
+        assert (int(wb.action[i]), int(wb.oid[i]), int(wb.aid[i]),
+                int(wb.sid[i]), int(wb.price[i]), int(wb.size[i])) == (
+            m.action, m.oid, m.aid, m.sid, m.price, m.size)
+        assert bool(wb.hnext[i]) == (m.next is not None)
+        assert bool(wb.hprev[i]) == (m.prev is not None)
+
+
+def test_frames_to_values_matches_canonical_order_json():
+    """The broker stores canonical order_json for every frame — the
+    encoding must be invisible to the durable log and the oracle."""
+    msgs = _msgs(48, seed=3)
+    _wb, values = wire.frames_to_values(wire.encode_frames(msgs))
+    assert values == [wire.dumps_order(m) for m in msgs]
+
+
+def test_accept_frames_routes_like_numpy_authority():
+    from kme_tpu.bridge import front
+
+    msgs = _msgs(200, seed=1)
+    buf = wire.encode_frames(msgs)
+    for ngroups in (1, 2, 4, 7):
+        wb, groups, plan = front.accept_frames(buf, ngroups)
+        want = front.accept_routes(wb.action, wb.oid, wb.aid, wb.sid,
+                                   ngroups)
+        assert groups.dtype == np.int32
+        assert np.array_equal(groups, want), f"ngroups={ngroups}"
+        assert plan is None
+        # scalar authority spot-check over every row
+        for i, m in enumerate(msgs):
+            if m.action in (100, 101):
+                exp = front.account_group(m.aid, ngroups)
+            elif m.action == 4:
+                exp = front.group_of(m.oid, ngroups, front.SALT_SYMBOL)
+            else:
+                exp = front.symbol_group(m.sid, ngroups)
+            assert int(groups[i]) == exp, f"row {i} action {m.action}"
+
+
+def test_accept_frames_one_call_plan_matches_plan_batch():
+    from kme_tpu.bridge import front
+    from kme_tpu.native import load_library
+    from kme_tpu.native import sched
+    from kme_tpu.runtime.seqsession import NativeSeqRouter
+
+    lib = load_library()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    msgs = [m for m in _msgs(100, seed=2)
+            if m.action in (0, 1, 2, 3, 4)]    # router-plannable ops
+    buf = wire.encode_frames(msgs)
+    B = 16
+    r1 = NativeSeqRouter(64, 512, lib)
+    r2 = NativeSeqRouter(64, 512, lib)
+    wb, _groups, plan = front.accept_frames(buf, 1, router=r1, B=B)
+    want = sched.plan_batch(r2, wire.WireBatch.parse_frames(buf), B)
+    assert plan is not None and want is not None
+    cols_a, rej_a, stacked_a, cnts_a, k_a = plan
+    cols_b, rej_b, stacked_b, cnts_b, k_b = want
+    assert k_a == k_b
+    assert rej_a == rej_b
+    assert cnts_a == cnts_b
+    assert set(stacked_a) == set(stacked_b)
+    for name in stacked_a:
+        assert np.array_equal(stacked_a[name], stacked_b[name]), name
+    assert set(cols_a) == set(cols_b)
+    for name in cols_a:
+        assert np.array_equal(cols_a[name], cols_b[name]), name
+
+
+def test_produce_frames_parity_with_produce_loop():
+    msgs = _msgs(40, seed=4)
+    buf = wire.encode_frames(msgs)
+    b1 = InProcessBroker()
+    b1.create_topic("in")
+    b2 = InProcessBroker()
+    b2.create_topic("in")
+    n, last = b1.produce_frames("in", "K", buf, epoch=3, seq0=100,
+                                ats=777)
+    for i, m in enumerate(msgs):
+        b2.produce("in", "K", wire.dumps_order(m), epoch=3,
+                   out_seq=100 + i, ats=777)
+    assert (n, last) == (40, 39)
+    rows = lambda b: [(r.offset, r.key, r.value, r.epoch, r.out_seq,
+                       r.ats) for r in b.fetch("in", 0, 100)]
+    assert rows(b1) == rows(b2)
+    assert b1.wire_binary_records == 40
+    assert b1.wire_parse_ns > 0
+    # replaying the same (epoch, seq0) batch is fully dup-suppressed,
+    # mirroring produce() returning -1 for a suppressed record
+    n2, last2 = b1.produce_frames("in", "K", buf, epoch=3, seq0=100)
+    assert (n2, last2) == (0, -1)
+    assert b1.end_offset("in") == 40
+
+
+def test_produce_frames_durable_log_identical(tmp_path):
+    """The durable rows a binary batch writes are byte-identical to the
+    JSON path's — reload proves it."""
+    msgs = _msgs(16, seed=5)
+    buf = wire.encode_frames(msgs)
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    b1 = InProcessBroker(persist_dir=d1)
+    b1.create_topic("in")
+    b1.produce_frames("in", None, buf, epoch=1, seq0=0)
+    b2 = InProcessBroker(persist_dir=d2)
+    b2.create_topic("in")
+    for i, m in enumerate(msgs):
+        b2.produce("in", None, wire.dumps_order(m), epoch=1, out_seq=i)
+    b1.sync()
+    b2.sync()
+    log1 = (tmp_path / "a" / "in.log").read_bytes()
+    log2 = (tmp_path / "b" / "in.log").read_bytes()
+    assert log1 == log2
+    rb = InProcessBroker(persist_dir=d1)
+    rb.create_topic("in")
+    assert [r.value for r in rb.fetch("in", 0, 100)] == [
+        wire.dumps_order(m) for m in msgs]
+
+
+def test_produce_frames_mid_batch_refusal_keeps_admitted_prefix():
+    msgs = _msgs(20, seed=6)
+    buf = wire.encode_frames(msgs)
+    b = InProcessBroker(max_lag=5)
+    b.create_topic("in")
+    b.commit("in", 0)       # arm bounded ingress
+    with pytest.raises(BrokerOverload) as e:
+        b.produce_frames("in", None, buf)
+    assert e.value.admitted == 5
+    assert b.end_offset("in") == 5
+    # the resume contract: back off, then continue from the prefix
+    b.commit("in", 5)
+    with pytest.raises(BrokerOverload) as e2:
+        b.produce_frames("in", None,
+                         buf[e.value.admitted * wire.FRAME_SIZE:])
+    assert e2.value.admitted == 5 and b.end_offset("in") == 10
+
+
+def test_produce_frames_admission_classes_match_json_path():
+    """classify_actions (columnar) must agree with classify_produce
+    (per-JSON-record) for every opcode, so the overload controller
+    sheds identically whichever encoding carried the record."""
+    from kme_tpu.bridge.broker import (classify_actions,
+                                       classify_produce)
+
+    msgs = _msgs(200, seed=7)
+    acts = np.array([m.action for m in msgs], np.int64)
+    want = [classify_produce(wire.dumps_order(m))[0] for m in msgs]
+    assert classify_actions(acts).tolist() == want
+
+
+def test_tcp_binary_produce_and_fetch_bin_roundtrip():
+    msgs = _msgs(40, seed=8)
+    buf = wire.encode_frames(msgs)
+    srv, broker = serve_broker("127.0.0.1", 0)
+    broker.create_topic("t")
+    cli = TcpBroker(*srv.server_address[:2])
+    try:
+        n, last = cli.produce_frames("t", "K", buf, epoch=1, seq0=0)
+        assert (n, last) == (40, 39)
+        ra = cli.fetch("t", 0, 100)
+        rb = cli.fetch_bin("t", 0, 100)
+        assert [(r.offset, r.key, r.value, r.epoch, r.out_seq, r.ats)
+                for r in ra] == \
+               [(r.offset, r.key, r.value, r.epoch, r.out_seq, r.ats)
+                for r in rb]
+        assert [r.value for r in rb] == [wire.dumps_order(m)
+                                        for m in msgs]
+        # JSON and binary interleave on the same connection
+        off = cli.produce("t", None, wire.dumps_order(msgs[0]))
+        assert off == 40
+        n2, _ = cli.produce_frames("t", None, buf[:wire.FRAME_SIZE])
+        assert n2 == 1
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+def test_tcp_overload_reply_carries_admitted():
+    msgs = _msgs(20, seed=9)
+    buf = wire.encode_frames(msgs)
+    b = InProcessBroker(max_lag=5)
+    srv, broker = serve_broker("127.0.0.1", 0, b)
+    broker.create_topic("t")
+    broker.commit("t", 0)
+    cli = TcpBroker(*srv.server_address[:2])
+    try:
+        with pytest.raises(BrokerOverload) as e:
+            cli.produce_frames("t", None, buf)
+        assert e.value.admitted == 5
+        assert broker.end_offset("t") == 5
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+def test_ats_survives_reconnect_retry():
+    """The coordinated-omission fix: a produce that dies on a transport
+    fault keeps its original admission stamp when the caller retries
+    the same record over the reconnected socket — for both the JSON
+    and the binary path. A different record gets a fresh stamp."""
+    msgs = _msgs(4, seed=10)
+    buf = wire.encode_frames(msgs)
+    for binary in (False, True):
+        srv, broker = serve_broker("127.0.0.1", 0)
+        broker.create_topic("t")
+        cli = TcpBroker(*srv.server_address[:2])
+        faults.configure("tcp.disconnect:n=1")
+        try:
+            send = ((lambda: cli.produce_frames("t", None, buf))
+                    if binary else
+                    (lambda: cli.produce("t", None,
+                                         wire.dumps_order(msgs[0]))))
+            with pytest.raises(BrokerError):
+                send()
+            kept = cli._pending[1]
+            time.sleep(0.02)
+            send()      # same record(s): stamp must be reused
+            assert cli._pending is None
+            recs = cli.fetch("t", 0, 10)
+            assert all(r.ats == kept for r in recs), (
+                binary, [r.ats for r in recs], kept)
+            # a different record restarts the clock
+            off = cli.produce("t", None, wire.dumps_order(msgs[1]))
+            assert cli.fetch("t", off, 1)[0].ats > kept
+        finally:
+            faults.clear()
+            cli.close()
+            srv.shutdown()
+
+
+def test_wire_gauges_published():
+    """kme-serve's telemetry surface: wire_binary_frac and
+    parse_ns_per_msg ride _publish_batch off the broker counters, and
+    kme-top renders the wire row when the gauge is present."""
+    from kme_tpu.telemetry import top
+
+    b = InProcessBroker()
+    b.create_topic("in")
+    b.commit("in", 0)       # admission-bounded: JSON produces count
+    b.produce("in", None, wire.dumps_order(_msgs(1)[0]))
+    b.produce_frames("in", None, wire.encode_frames(_msgs(3, seed=11)),
+                     epoch=1, seq0=0)
+    assert b.wire_json_records == 1 and b.wire_binary_records == 3
+    frac = b.wire_binary_records / (b.wire_binary_records
+                                    + b.wire_json_records)
+    view = {
+        "leader": {"ok": True, "metrics": {
+            "gauges": {"wire_binary_frac": round(frac, 6),
+                       "parse_ns_per_msg": 1234},
+            "counters": {}, "latencies": {}}, "hb": {}},
+        "standby": {"ok": False},
+        "supervisor": None,
+    }
+    lines = top.render(top.build_view(view))
+    wire_rows = [ln for ln in lines if "wire binary=" in ln]
+    assert wire_rows and "75.0%" in wire_rows[0] \
+        and "1,234ns/msg" in wire_rows[0]
+
+
+def test_loadgen_connections_binary_exactly_once():
+    """kme-loadgen --connections --binary against a served broker:
+    every simulated client's records land exactly once (unique
+    out_seq stamps, no gaps) and the report is written."""
+    import json as _json
+
+    from kme_tpu import cli as kcli
+    from kme_tpu.bridge.service import TOPIC_IN
+
+    srv, broker = serve_broker("127.0.0.1", 0)
+    host, port = srv.server_address[:2]
+    try:
+        report = None
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            rp = td + "/report.json"
+            rc = kcli.loadgen_main(
+                ["--events", "600", "--broker", f"{host}:{port}",
+                 "--connections", "100", "--binary", "--report", rp])
+            assert rc == 0
+            report = _json.load(open(rp))
+        n = report["events"]
+        assert report["produced"] == n == broker.end_offset(TOPIC_IN)
+        recs = broker.fetch(TOPIC_IN, 0, 10_000)
+        seqs = sorted(r.out_seq for r in recs)
+        assert seqs == list(range(n))       # zero dup stamps, no gaps
+        assert all(r.ats is not None for r in recs)
+    finally:
+        srv.shutdown()

@@ -156,6 +156,150 @@ def test_recon_fuzz_native_vs_python_byte_exact():
         assert rn == rp, f"reject reason codes diverged at {lo}"
 
 
+def _random_frame_msg(rng: random.Random) -> OrderMsg:
+    def i64():
+        r = rng.random()
+        if r < 0.1:
+            return rng.choice([-I64 - 1, I64, 0, -1])
+        if r < 0.3:
+            return -rng.randrange(1 << 31)
+        return rng.randrange(1 << 31)
+
+    return OrderMsg(action=i64(), oid=i64(), aid=i64(), sid=i64(),
+                    price=i64(), size=i64(),
+                    next=None if rng.random() < 0.4 else i64(),
+                    prev=None if rng.random() < 0.4 else i64())
+
+
+def _mangle(rng: random.Random, buf: bytes):
+    """One seeded corruption of a valid frame buffer -> (bad_buf,
+    expected reason). Covers the ISSUE's fuzz classes: truncation
+    (header- and body-level), version skew, flipped kind byte,
+    oversized/undersized length prefix, trashed magic."""
+    from kme_tpu.wire import FRAME_SIZE
+
+    b = bytearray(buf)
+    nf = len(b) // FRAME_SIZE
+    fo = rng.randrange(nf) * FRAME_SIZE
+    kind = rng.randrange(5)
+    if kind == 0:       # truncate inside a header or body
+        cut = fo + rng.randrange(1, FRAME_SIZE)
+        return bytes(b[:cut]), "truncated"
+    if kind == 1:       # version skew
+        b[fo + 1] = rng.choice([0, 2, 7, 255])
+        return bytes(b), "version_skew"
+    if kind == 2:       # flipped kind byte
+        b[fo + 2] = rng.choice([1, 2, 3, 255])
+        return bytes(b), "bad_kind"
+    if kind == 3:       # oversized / undersized length prefix
+        bad_len = rng.choice([0, 8, FRAME_SIZE - 1, FRAME_SIZE + 1,
+                              1 << 20, 0xFFFFFFFF])
+        b[fo + 4:fo + 8] = bad_len.to_bytes(4, "little")
+        return bytes(b), "bad_length"
+    b[fo] = rng.choice([0, ord("{"), 0xB0, 0xFF])   # trashed magic
+    return bytes(b), "bad_magic"
+
+
+def test_binary_frame_fuzz_rejects_cleanly():
+    """Corrupted 72-byte frame buffers must raise WireFrameError with
+    the right reason and the rej_malformed class — never crash, never
+    mis-parse — through BOTH parse entry points (decode authority and
+    the batch parser, native or numpy)."""
+    from kme_tpu.wire import (REJ_MALFORMED, WireBatch, WireFrameError,
+                              decode_frames, encode_frames)
+
+    rng = random.Random(0xF4A3)
+    for trial in range(200):
+        msgs = [_random_frame_msg(rng)
+                for _ in range(rng.randrange(1, 12))]
+        buf = encode_frames(msgs)
+        bad, reason = _mangle(rng, buf)
+        with pytest.raises(WireFrameError) as e1:
+            decode_frames(bad)
+        with pytest.raises(WireFrameError) as e2:
+            WireBatch.parse_frames(bad)
+        for exc in (e1.value, e2.value):
+            assert exc.reason == reason, (
+                f"trial {trial}: want {reason}, got {exc.reason}")
+            assert exc.code == REJ_MALFORMED
+        # both entry points walk back through the same authority, so
+        # the message text is identical too
+        assert str(e1.value) == str(e2.value), f"trial {trial}"
+
+
+def test_binary_frame_fuzz_roundtrip_clean_buffers():
+    """Seeded clean buffers round-trip byte-exactly: encode -> batch
+    parse -> per-column compare vs the scalar decoder."""
+    from kme_tpu.wire import WireBatch, decode_frames, encode_frames
+
+    rng = random.Random(0xBEEF)
+    for _ in range(50):
+        msgs = [_random_frame_msg(rng)
+                for _ in range(rng.randrange(0, 32))]
+        buf = encode_frames(msgs)
+        wb = WireBatch.parse_frames(buf)
+        want = decode_frames(buf)
+        assert wb.n == len(want) == len(msgs)
+        for i, m in enumerate(want):
+            got = OrderMsg(
+                int(wb.action[i]), int(wb.oid[i]), int(wb.aid[i]),
+                int(wb.sid[i]), int(wb.price[i]), int(wb.size[i]),
+                int(wb.next[i]) if wb.hnext[i] else None,
+                int(wb.prev[i]) if wb.hprev[i] else None)
+            assert got == m == msgs[i]
+
+
+def test_binary_envelope_fuzz_over_tcp():
+    """Malformed binary PRODUCE envelopes through a real socket: the
+    server answers a clean rej_malformed JSON error and the connection
+    stays in lockstep for the next (valid) request."""
+    import struct
+
+    from kme_tpu.bridge.tcp import (_ENV_HDR, _ENV_META, TcpBroker,
+                                    serve_broker)
+    from kme_tpu.wire import (FRAME_PRODUCE, WIRE_MAGIC, WIRE_VERSION,
+                              encode_frames)
+
+    srv, broker = serve_broker("127.0.0.1", 0)
+    broker.create_topic("T")
+    cli = TcpBroker(*srv.server_address[:2])
+    rng = random.Random(0x7CB)
+    try:
+        frames = encode_frames([_random_frame_msg(rng)
+                                for _ in range(4)])
+        tb = b"T"
+        good_body = (struct.pack("<H", len(tb)) + tb + bytes([255])
+                     + _ENV_META.pack(-(1 << 63), -(1 << 63),
+                                      -(1 << 63)) + frames)
+        cases = [
+            # version skew in the envelope header
+            _ENV_HDR.pack(WIRE_MAGIC, 9, FRAME_PRODUCE, 0,
+                          len(good_body)) + good_body,
+            # flipped kind byte
+            _ENV_HDR.pack(WIRE_MAGIC, WIRE_VERSION, 7, 0,
+                          len(good_body)) + good_body,
+            # body too short for its own topic/meta header
+            _ENV_HDR.pack(WIRE_MAGIC, WIRE_VERSION, FRAME_PRODUCE,
+                          0, 1) + b"\x00",
+            # frames themselves corrupted (version skew inside frame 0;
+            # same byte count, so the stream cannot desync)
+            _ENV_HDR.pack(WIRE_MAGIC, WIRE_VERSION, FRAME_PRODUCE,
+                          0, len(good_body))
+            + good_body[:-len(frames)]
+            + bytes([frames[0], 9]) + frames[2:],
+        ]
+        for i, payload in enumerate(cases):
+            with pytest.raises(ValueError):
+                cli._roundtrip(payload)
+            # stream must still be usable: a valid produce lands
+            n, _last = cli.produce_frames("T", None, frames)
+            assert n == 4, f"case {i} poisoned the connection"
+        assert broker.end_offset("T") == 4 * len(cases)
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
 def test_tcp_rows_roundtrip_3_5_6_elements():
     """The transport's shortest-lossless row shapes: [o,k,v] (reloaded
     log records, no stamps), +[epoch,out_seq] (exactly-once stamped),
