@@ -109,6 +109,20 @@ REPLAY_SCOPES: Dict[str, Set[str]] = {
     "kme_tpu/parallel/seqmesh.py": {"plan_rebalance"},
 }
 
+# Trace-identity scopes (KME-D00x, same determinism rules): trace ids
+# are REPLAY-DERIVED identity — a crash-replay must re-mint the exact
+# same id for the same order, and a post-mortem stitch re-derives them
+# offline. A wall clock or RNG in any of these functions breaks the
+# waterfall join silently (ids stop matching across replay segments),
+# so the lint holds the line the tests can't see. Merged into
+# replay_fns per file by _RuleVisitor.
+TRACE_SCOPES: Dict[str, Set[str]] = {
+    "kme_tpu/telemetry/dtrace.py": {
+        "_tid_mix", "trace_id", "local_tid", "child_tid",
+        "client_trace_id", "route_map", "collect_group_spans",
+        "_spans_from_lat", "stitch"},
+}
+
 # Tracer scopes: whole directories — everything under them runs (or is
 # staged to run) under jit/vmap/scan/pallas_call.
 TRACED_DIRS = ("kme_tpu/engine/", "kme_tpu/ops/")
@@ -149,7 +163,8 @@ class _RuleVisitor(ast.NodeVisitor):
         self.findings: List[Finding] = []
         self._scope: List[str] = []
         self.hot_fns = HOT_SCOPES.get(relpath, set())
-        self.replay_fns = REPLAY_SCOPES.get(relpath, set())
+        self.replay_fns = (REPLAY_SCOPES.get(relpath, set())
+                           | TRACE_SCOPES.get(relpath, set()))
         self.traced = relpath.startswith(TRACED_DIRS)
 
     # -- bookkeeping ----------------------------------------------------

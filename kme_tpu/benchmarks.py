@@ -1468,13 +1468,22 @@ def bench_wire(events: int = 20_000, seed: int = 0,
     the stored stream replays through the Python oracle to identical
     MatchOut lines — so the speedup can never come from changing what
     gets admitted. The binary/JSON ratio is also asserted >= 1.5 on
-    CPU (the ISSUE's floor for the whole exercise)."""
+    CPU (the ISSUE's floor for the whole exercise).
+
+    A third timed pass drives the SAME frames with per-order client
+    trace ids attached (80-byte FLAG_TID frames, dtrace
+    client_trace_id): `trace_overhead_frac` is the ingress-rate cost
+    of tracing, reported as an ADVISORY in the tail (soft 5% budget —
+    printed, never gated) with the sample trace ids a kme-loadgen run
+    over the same stream would report."""
     import tempfile
     import time
 
     from kme_tpu.bridge import tcp as tcpmod
     from kme_tpu.bridge.broker import InProcessBroker
     from kme_tpu.oracle import OracleEngine
+    from kme_tpu.telemetry.dtrace import (client_trace_id,
+                                          client_trace_ids)
     from kme_tpu.wire import dumps_order, encode_frames, parse_order
     from kme_tpu.workload import harness_stream
 
@@ -1482,32 +1491,43 @@ def bench_wire(events: int = 20_000, seed: int = 0,
                           num_symbols=16, validate=True)
     n = len(msgs)
     lines = [dumps_order(m) for m in msgs]
-    chunks = [msgs[lo:lo + batch] for lo in range(0, n, batch)]
+    chunks = [(lo, msgs[lo:lo + batch]) for lo in range(0, n, batch)]
     t0 = time.perf_counter()
     with tempfile.TemporaryDirectory() as td:
         broker = InProcessBroker(persist_dir=td)
         srv, _ = tcpmod.serve_broker(port=0, broker=broker)
         host, port = srv.server_address
         cli = tcpmod.TcpBroker(host, port)
-        runs = {"json": [], "binary": []}
+        runs = {"json": [], "binary": [], "traced": []}
         parse_s = None
         stored = {}
         try:
             for rep in range(repeats):
-                for mode in ("json", "binary"):
+                for mode in ("json", "binary", "traced"):
                     topic = f"wire_{mode}_r{rep}"
                     cli.create_topic(topic)
                     pns0 = broker.wire_parse_ns
                     t1 = time.perf_counter()
                     if mode == "json":
-                        for ch in chunks:
+                        for _, ch in chunks:
                             cli.produce_batch(
                                 topic,
                                 [(None, dumps_order(m)) for m in ch])
-                    else:
-                        for ch in chunks:
+                    elif mode == "binary":
+                        for _, ch in chunks:
                             cli.produce_frames(topic, None,
                                                encode_frames(ch))
+                    else:
+                        # the traced pass pays the FULL client cost:
+                        # minting the ids (vectorized, like loadgen)
+                        # and the wider 80-byte frames
+                        for lo, ch in chunks:
+                            tids = client_trace_ids(
+                                lo, [m.aid for m in ch],
+                                [m.oid for m in ch])
+                            cli.produce_frames(
+                                topic, None,
+                                encode_frames(ch, tids=tids))
                     dt = time.perf_counter() - t1
                     assert broker.end_offset(topic) == n, (
                         f"{mode} ingress lost records: "
@@ -1527,9 +1547,13 @@ def bench_wire(events: int = 20_000, seed: int = 0,
         finally:
             cli.close()
             srv.shutdown()
-    # byte parity: the encoding must be invisible past admission
+    # byte parity: the encoding must be invisible past admission —
+    # including the trace words (tid is transport metadata, never part
+    # of the stored value)
     assert stored["json"] == stored["binary"], (
         "binary ingress altered the stored record bytes")
+    assert stored["json"] == stored["traced"], (
+        "trace-id carriage altered the stored record bytes")
     oracle_out = {}
     for mode, vals in stored.items():
         eng = OracleEngine("fixed")
@@ -1541,9 +1565,12 @@ def bench_wire(events: int = 20_000, seed: int = 0,
         "oracle replay diverged between ingress encodings")
     json_s = min(runs["json"])
     bin_s = min(runs["binary"])
+    traced_s = min(runs["traced"])
     json_mps = n / json_s
     bin_mps = n / bin_s
+    traced_mps = n / traced_s
     speedup = bin_mps / json_mps
+    overhead = max(0.0, 1.0 - traced_mps / bin_mps)
     import jax
 
     backend = jax.default_backend()
@@ -1564,10 +1591,26 @@ def bench_wire(events: int = 20_000, seed: int = 0,
         # gated metrics (perfgate reads the detail root)
         "ingress_msgs_per_sec": round(bin_mps, 1),
         "wire_parse_s": round(parse_s, 6),
+        # advisory, never gated: the cost of carrying client trace ids
+        # (80-byte FLAG_TID frames) on the binary ingress path, plus
+        # the ids the tail quotes — the SAME deterministic
+        # client_trace_id values a kme-loadgen run over this stream
+        # reports in its slow_samples section
+        "traced_msgs_per_sec": round(traced_mps, 1),
+        "trace_overhead_frac": round(overhead, 4),
+        "trace_sample_ids": [
+            f"0x{client_trace_id(j, msgs[j].aid, msgs[j].oid):016x}"
+            for j in range(min(4, n))],
     }
+    over_tag = (" ** over 5% advisory budget **"
+                if overhead > 0.05 else "")
     print(f"kme-bench wire: json={json_mps:,.0f} msg/s "
           f"binary={bin_mps:,.0f} msg/s ({speedup:.2f}x) "
+          f"traced={traced_mps:,.0f} msg/s "
+          f"(overhead {overhead:.1%}{over_tag}) "
           f"parse={parse_s:.4f}s ({elapsed:.1f}s)", file=sys.stderr)
+    print(f"kme-bench wire: sample trace ids "
+          f"{' '.join(detail['trace_sample_ids'])}", file=sys.stderr)
     return {
         "metric": "ingress_msgs_per_sec",
         "value": round(bin_mps, 1),

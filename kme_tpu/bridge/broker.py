@@ -93,6 +93,13 @@ class Record:
     # [key,value(,epoch,out_seq)] shape, so records reloaded after a
     # restart carry ats=None and latency attribution simply skips them.
     ats: Optional[int] = None
+    # transport-advisory trace word (wire FLAG_TID / produce "tid").
+    # In-memory only, like ats: the AUTHORITATIVE trace id is always
+    # derived from durable identity (dtrace.trace_id over the record's
+    # offset), so traces survive reloads that drop this field. Carried
+    # ids exist so clients can correlate their own sends with the
+    # derived waterfalls (loadgen RTT sampling).
+    tid: Optional[int] = None
 
 
 class _Topic:
@@ -534,7 +541,8 @@ class InProcessBroker:
     def produce(self, topic: str, key: Optional[str], value: str,
                 epoch: Optional[int] = None,
                 out_seq: Optional[int] = None,
-                ats: Optional[int] = None) -> int:
+                ats: Optional[int] = None,
+                tid: Optional[int] = None) -> int:
         """Append one record; returns its offset. With an
         ``(epoch, out_seq)`` stamp the append is fenced and idempotent:
         a stale epoch raises BrokerFenced, and an ``out_seq`` at or
@@ -545,7 +553,10 @@ class InProcessBroker:
         ``ats`` overrides the admission stamp (microseconds): remote
         producers stamp at their FIRST send attempt and re-send the
         same stamp across reconnects, so latency histograms include the
-        reconnect delay instead of hiding it (coordinated omission)."""
+        reconnect delay instead of hiding it (coordinated omission).
+
+        ``tid`` attaches a transport-advisory trace word to the
+        in-memory record (Record.tid); durable rows are unchanged."""
         if faults.should("broker.produce"):
             raise BrokerError("injected fault: broker.produce")
         with self._data:
@@ -583,7 +594,7 @@ class InProcessBroker:
 
                     ats = _time.time_ns() // 1000
                 t.log.append(Record(off, key, value, epoch, out_seq,
-                                    ats))
+                                    ats, tid))
                 if out_seq is not None:
                     t.max_out_seq = out_seq
                 if topic in self._commits:
@@ -620,8 +631,10 @@ class InProcessBroker:
                        seq0: Optional[int] = None,
                        ats: Optional[int] = None):
         """Binary batch append: one contiguous buffer of 72-byte wire
-        frames (wire.py layout) -> records, without materializing a
-        Python dict per record. The frames decode ONCE (native
+        frames (wire.py layout; 80 bytes when FLAG_TID carries a trace
+        word) -> records, without materializing a Python dict per
+        record. Trace words land on Record.tid only — the stored value
+        bytes and durable rows are identical with tracing on or off. The frames decode ONCE (native
         kme_parse_frames + the pinned kme_parse_emit emitter when
         available) into the canonical order_json values the broker
         always stores — the durable log, oracle replay, and MatchOut
@@ -694,7 +707,7 @@ class InProcessBroker:
                         break
                 off = len(t.log)
                 t.log.append(Record(off, key, values[i], epoch, out_seq,
-                                    ats))
+                                    ats, wb.record_tid(i)))
                 if out_seq is not None:
                     t.max_out_seq = out_seq
                 if t.logfile is not None:

@@ -415,6 +415,12 @@ def run_shard_failover(args, run_dir: str, report_path: str) -> int:
     lines = [dumps_order(m) for m in msgs]
     per_group, router = front.split_lines(lines, groups,
                                           prefund=args.prefund)
+    # durable copy of the front's input stream: kme-trace --cluster
+    # stitches this run dir post-mortem (dtrace.stitch_state_root
+    # re-runs the deterministic split over front.in to rebuild the
+    # global-offset -> (group, local index) map)
+    with open(os.path.join(run_dir, "front.in"), "w") as f:
+        f.write("\n".join(lines) + "\n")
     sizes = [len(s) for s in per_group]
     if min(sizes) == 0:
         print(f"kme-chaos: substream sizes {sizes} — empty group; "
@@ -450,7 +456,14 @@ def run_shard_failover(args, run_dir: str, report_path: str) -> int:
                       "--group", f"{k}/{groups}",
                       "--listen", f"127.0.0.1:{port}",
                       "--idle-exit", str(args.idle_exit),
-                      "--health-every", "0.1"]
+                      "--health-every", "0.1",
+                      # per-group latency journal + span tracing: the
+                      # post-mortem stitches every admitted order into
+                      # a cluster waterfall (journal resume=True, so a
+                      # restarted leader appends after the kill)
+                      "--journal-out",
+                      os.path.join(ckpt, "journal.bin"),
+                      "--trace-spans"]
         sup_cmd = [sys.executable, "-m", "kme_tpu.cli", "supervise",
                    "--checkpoint-dir", ckpt,
                    "--stale-after", str(args.stale_after),
@@ -604,6 +617,56 @@ def run_shard_failover(args, run_dir: str, report_path: str) -> int:
         failures.append(f"merged stream diverged from the single-"
                         f"leader oracle: {verify['mismatches'][:1]}")
 
+    # trace integrity post-mortem: the per-group span journals must
+    # stitch into exactly one complete waterfall per admitted order.
+    # The victim's replayed overlap dedups away by the durable
+    # (group, local_off, kind) key — first occurrence wins, mirroring
+    # the broker's (epoch, out_seq) dedup — and the standby promotion
+    # shows as a span GAP inside one waterfall, never a forked second
+    # trace for the same order.
+    from kme_tpu.telemetry import dtrace
+    from kme_tpu.telemetry.journal import read_events
+    trace_post: dict = {}
+    try:
+        tdoc = dtrace.stitch_state_root(run_dir,
+                                        prefund=args.prefund)
+        frac = (tdoc["stitched"] / tdoc["admitted"]
+                if tdoc["admitted"] else 0.0)
+        offs = [o["off"] for o in tdoc["orders"]]
+        forked = len(offs) - len(set(offs))
+        # raw replay overlap in the victim's journal (pre-dedup):
+        # span records the restarted leader re-journaled for offsets
+        # the dead leader had already covered
+        replay_dups = 0
+        jp = dtrace._find_journal(gdirs[victim])
+        if jp is not None:
+            seen = set()
+            for ev in read_events(jp):
+                if ev.get("e") == "span":
+                    key = (ev.get("off"), ev.get("kind"))
+                    if key in seen:
+                        replay_dups += 1
+                    else:
+                        seen.add(key)
+        trace_post = {"admitted": tdoc["admitted"],
+                      "stitched": tdoc["stitched"],
+                      "stitched_frac": round(frac, 5),
+                      "forked_waterfalls": forked,
+                      "victim_replayed_spans_deduped": replay_dups}
+        if tdoc["admitted"] == 0:
+            failures.append("tracing: stitched trace admitted zero "
+                            "orders")
+        elif frac < 0.999:
+            failures.append(f"tracing: only {frac:.2%} of admitted "
+                            f"orders stitched into complete cluster "
+                            f"waterfalls (bound 99.9%)")
+        if forked:
+            failures.append(f"tracing: {forked} order(s) forked a "
+                            f"second waterfall across the failover")
+    except (OSError, ValueError) as e:
+        trace_post = {"error": str(e)}
+        failures.append(f"tracing: post-mortem stitch failed: {e}")
+
     # zombie fence: a stale-epoch produce against the victim's healed
     # MatchOut log must be rejected before anything is appended
     probe = InProcessBroker(persist_dir=os.path.join(
@@ -640,6 +703,7 @@ def run_shard_failover(args, run_dir: str, report_path: str) -> int:
         "duplicate_stamps": dup_stamps,
         "cross_shard_transfers":
             router.counters["cross_shard_transfers_total"],
+        "trace": trace_post,
         "stale_epoch_fenced": stale_fenced,
         "verify": dict(verify,
                        mismatches=verify.get("mismatches", [])[:3]),
@@ -653,6 +717,8 @@ def run_shard_failover(args, run_dir: str, report_path: str) -> int:
           f"victim=g{victim} promotions={len(promoted)} "
           f"failover_seconds={fo} dips={dips} "
           f"dup_stamps={sum(dup_stamps.values())} "
+          f"waterfalls={trace_post.get('stitched')}/"
+          f"{trace_post.get('admitted')} "
           f"stale_epoch_fenced={stale_fenced} parity="
           f"{'byte-exact' if verify['ok'] else 'DIVERGED'} "
           f"elapsed={elapsed:.1f}s", file=sys.stderr)

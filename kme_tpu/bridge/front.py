@@ -482,6 +482,41 @@ def verify_groups(lines: Sequence[str],
     return report
 
 
+def write_front_trace(path: str, lines: Sequence[str], ngroups: int,
+                      transfers: bool = True, prefund: int = 8) -> int:
+    """Record the front door's own trace spans: one front_accept and
+    one route span per input line, stamped with the order's GLOBAL
+    deterministic trace id and its routed (group, local index) — the
+    anchor `kme-trace --cluster` joins group-side spans against. Spans
+    are zero-width position marks (the split is a deterministic
+    function, not a runtime hop); what matters is the identity they
+    carry. Returns the number of spans written."""
+    import time
+
+    from kme_tpu.telemetry.dtrace import route_map
+    from kme_tpu.telemetry.journal import Journal
+
+    entries, _router = route_map(lines, ngroups, transfers=transfers,
+                                 prefund=prefund)
+    now = time.time_ns() // 1000
+    spans = []
+    for ent in entries:
+        if ent is None:
+            continue
+        base = {"g": -1, "off": ent["off"], "oid": ent["oid"],
+                "aid": ent["aid"], "tid": ent["tid"], "ptid": 0,
+                "t0": now, "t1": now, "li": ent["li"]}
+        spans.append(dict(base, kind="front_accept"))
+        spans.append(dict(base, kind="route", g=ent["g"],
+                          ptid=ent["tid"]))
+    j = Journal(path, resume=False)
+    try:
+        j.record_spans(spans)
+    finally:
+        j.close()
+    return len(spans)
+
+
 # -- CLI ---------------------------------------------------------------
 
 
@@ -513,6 +548,12 @@ def main(argv=None) -> int:
     p.add_argument("--in-dir", default=None, metavar="DIR",
                    help="merge/verify: read group{K}.out per-group "
                         "MatchOut line files from here")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="split: record front_accept/route trace spans "
+                        "(deterministic per-order trace ids, "
+                        "telemetry/dtrace.py) to this journal; "
+                        "kme-trace --cluster reads it as "
+                        "<state-root>/front.trace")
     p.add_argument("--no-transfers", action="store_true",
                    help="split symbols only; skip balance-transfer "
                         "injection (parity then requires every account "
@@ -546,6 +587,10 @@ def main(argv=None) -> int:
             with open(os.path.join(args.out_dir,
                                    f"group{g}.in"), "w") as f:
                 f.write("\n".join(per[g]) + ("\n" if per[g] else ""))
+        if args.trace_out is not None:
+            write_front_trace(args.trace_out, lines, n,
+                              transfers=not args.no_transfers,
+                              prefund=args.prefund)
         doc = {"groups": n, "input_lines": len(lines),
                "per_group": [len(x) for x in per]}
         doc.update(router.counters)

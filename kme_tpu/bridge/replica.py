@@ -147,8 +147,16 @@ class Replica:
                  max_messages: Optional[int] = None,
                  idle_exit: Optional[float] = None,
                  metrics_port: Optional[int] = None,
-                 group=None) -> None:
+                 group=None, journal_out: Optional[str] = None,
+                 trace_spans: bool = False) -> None:
         self.group = group
+        # armed at PROMOTION only: a follower's output is discarded, so
+        # journaling its stages would double-record every offset the
+        # leader already covered — the promoted leader resumes the
+        # leader's journal (resume=True) and continues the same
+        # per-order span stream (a gap during the outage, not a fork)
+        self.journal_out = journal_out
+        self.trace_spans = trace_spans
         self.checkpoint_dir = checkpoint_dir
         self.listen = listen
         self.max_lag = max_lag
@@ -309,6 +317,17 @@ class Replica:
         svc.broker = broker
         svc.follower = False
         svc._init_exactly_once(resumed=False)   # next epoch + fence
+        if self.journal_out is not None and svc.journal is None:
+            # resume the dead leader's journal so the per-order span
+            # stream CONTINUES across the failover (rewound to our
+            # applied offset exactly like the serve resume path — the
+            # overlap we re-process re-journals, and the stitcher
+            # dedups it by (group, local_off, kind))
+            from kme_tpu.telemetry import Journal
+
+            svc.journal = Journal(self.journal_out)
+            svc.journal.rewind_to_offset(svc.offset)
+            svc.trace_spans = bool(self.trace_spans)
         failover = None
         try:
             failed_at = float(promote["failed_at"])
@@ -391,6 +410,15 @@ def main(argv=None) -> int:
                    help="follow shard group K of N (namespaced "
                         "MatchIn.gK log; promotion rebinds the group's "
                         "own topics)")
+    p.add_argument("--journal-out", default=None, metavar="PATH",
+                   help="armed at PROMOTION: resume the dead leader's "
+                        "journal at this path and keep recording "
+                        "(same spelling as kme-serve, so forwarded "
+                        "serve_args just work)")
+    p.add_argument("--trace-spans", action="store_true",
+                   help="armed at PROMOTION: continue the leader's "
+                        "per-order span stream (requires "
+                        "--journal-out)")
     args, unknown = p.parse_known_args(argv)
     if unknown:
         # the supervisor forwards the leader's serve_args verbatim;
@@ -423,7 +451,8 @@ def main(argv=None) -> int:
                   max_messages=args.max_messages,
                   idle_exit=args.idle_exit,
                   metrics_port=args.metrics_port,
-                  group=group)
+                  group=group, journal_out=args.journal_out,
+                  trace_spans=args.trace_spans)
     try:
         return rep.run()
     except BrokerFenced as e:

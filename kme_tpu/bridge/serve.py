@@ -120,6 +120,13 @@ def main(argv=None) -> int:
                         "here; .bin/.kmej selects the compact binary "
                         "framing, anything else JSONL. Query with "
                         "kme-trace")
+    p.add_argument("--trace-spans", action="store_true",
+                   help="journal distributed-tracing span events "
+                        "(ingress/plan/device/produce per order, keyed "
+                        "by the deterministic group-local trace id) "
+                        "alongside the lifecycle stream; needs "
+                        "--journal-out. Stitch cluster-wide waterfalls "
+                        "with kme-trace --cluster")
     p.add_argument("--journal-rotate-mb", type=int, default=None,
                    metavar="MB", help="rotate the journal (logrotate-"
                         "style PATH -> PATH.1 shifts) once the live "
@@ -287,6 +294,7 @@ def main(argv=None) -> int:
                        exactly_once=exactly_once,
                        pipeline=args.pipeline,
                        group=group,
+                       trace_spans=args.trace_spans,
                        slo=(None if args.slo_p99_ms is None else {
                            "stage": args.slo_stage,
                            "p99_ms": args.slo_p99_ms,

@@ -67,7 +67,8 @@ class MatchService:
                  follower: bool = False,
                  pipeline: int = 0,
                  group=None,
-                 slo=None) -> None:
+                 slo=None,
+                 trace_spans: bool = False) -> None:
         if engine not in ("lanes", "seq", "oracle", "native"):
             raise ValueError(f"unknown engine {engine!r}")
         if compat not in ("java", "fixed"):
@@ -180,6 +181,10 @@ class MatchService:
                              "annotate_rejects (REJ records interleave "
                              "at non-deterministic batch boundaries)")
         self.degraded = None        # set by the invariant auditor
+        # distributed tracing (telemetry/dtrace.py): journal per-order
+        # "span" events keyed by local_tid(group, broker offset) — the
+        # stitcher joins them to the front's global trace ids offline
+        self.trace_spans = bool(trace_spans)
         self._slo_arg = slo         # dict of SLO kwargs, or None
         self.slo = None
         self._slo_reason = None
@@ -460,6 +465,10 @@ class MatchService:
         self._batch_ordinal = 0
         self._last_produce_s = 0.0
         self._phase_snap = {}
+        # slowest recent orders, worst first: published as registry
+        # exemplars so a cluster p99 outlier (kme-agg) resolves to a
+        # concrete waterfall (kme-trace --order AID:OID)
+        self._slow: list = []
         if self._slo_arg is not None:
             from kme_tpu.telemetry.slo import SLO
 
@@ -480,6 +489,72 @@ class MatchService:
                         lat_consume.observe(max(0, now_us - ats) * 1e-6)
 
             self.broker.deliver_observer = _on_deliver
+
+    _EXEMPLARS = 8
+
+    def _stamp_orders(self, offs, oids, aids, atss, fetch_us, done_us,
+                      plan_us, dev_us, prod_us, batch) -> None:
+        """Per-order stage attribution, shared by the serial and
+        pipelined collect paths: journal "lat" stamps, "span" events
+        when tracing is on (--trace-spans), and the slow-order exemplar
+        surface. Span bounds are contiguous from the admission stamp —
+        the exact layout telemetry/dtrace.py synthesizes from "lat"
+        events, so traced and untraced journals stitch identically.
+        Span identity is local_tid(group, broker offset): pure durable
+        identity, so a crash-replay re-emits the SAME ids and the
+        stitcher dedups the overlap by (group, off, kind)."""
+        n = len(offs)
+        if not n:
+            return
+        from kme_tpu.telemetry.dtrace import local_tid
+
+        g = self.group_id
+        if self.journal is not None:
+            self.journal.record_latency(
+                [{"off": offs[i], "oid": oids[i],
+                  "in_us": (max(0, fetch_us - atss[i])
+                            if atss[i] is not None else 0),
+                  "plan_us": plan_us, "dev_us": dev_us,
+                  "prod_us": prod_us,
+                  "e2e_us": (max(0, done_us - atss[i])
+                             if atss[i] is not None else 0)}
+                 for i in range(n)], batch=batch)
+            if self.trace_spans:
+                spans = []
+                for i in range(n):
+                    t = atss[i] if atss[i] is not None else fetch_us
+                    tid = local_tid(g, offs[i])
+                    for kind, dur in (
+                            ("ingress", (max(0, fetch_us - atss[i])
+                                         if atss[i] is not None
+                                         else 0)),
+                            ("plan", plan_us), ("device", dev_us),
+                            ("produce", prod_us)):
+                        spans.append(
+                            {"kind": kind, "g": g, "off": offs[i],
+                             "oid": oids[i], "aid": aids[i],
+                             "tid": tid, "ptid": 0, "t0": t,
+                             "t1": t + dur, "li": -1})
+                        t += dur
+                self.journal.record_spans(spans, batch=batch)
+        cap = self._EXEMPLARS
+        floor = (self._slow[-1]["e2e_us"]
+                 if len(self._slow) >= cap else -1)
+        changed = False
+        for i in range(n):
+            if atss[i] is None:
+                continue
+            e2e = max(0, done_us - atss[i])
+            if e2e > floor or len(self._slow) < cap:
+                self._slow.append(
+                    {"tid": local_tid(g, offs[i]), "off": offs[i],
+                     "oid": oids[i], "aid": aids[i], "g": g,
+                     "e2e_us": e2e})
+                changed = True
+        if changed:
+            self._slow.sort(key=lambda x: -x["e2e_us"])
+            del self._slow[cap:]
+            self.telemetry.set_exemplars(self._slow)
 
     # ------------------------------------------------------------------
     # durability: snapshot at batch boundaries, resume = load + replay
@@ -830,22 +905,16 @@ class MatchService:
             self.journal.record_batch(out or [], reasons=reasons,
                                       offsets=offs[:len(out or [])],
                                       drops=drops)
-        if self.journal is not None and n:
+        if n:
             # full batch wall per order (what the order EXPERIENCED —
             # same convention as the histograms above), not an
             # amortized per-order share
-            plan_us = int(plan_d * 1e6)
-            dev_us = int(dev_d * 1e6)
-            prod_us = int(self._last_produce_s * 1e6)
-            self.journal.record_latency(
-                [{"off": offs[i], "oid": int(msgs[i].oid),
-                  "in_us": (max(0, fetch_us - atss[i])
-                            if atss[i] is not None else 0),
-                  "plan_us": plan_us, "dev_us": dev_us,
-                  "prod_us": prod_us,
-                  "e2e_us": (max(0, done_us - atss[i])
-                             if atss[i] is not None else 0)}
-                 for i in range(n)], batch=self._batch_ordinal)
+            self._stamp_orders(
+                offs[:n], [int(m.oid) for m in msgs],
+                [int(m.aid) for m in msgs], atss, fetch_us, done_us,
+                int(plan_d * 1e6), int(dev_d * 1e6),
+                int(self._last_produce_s * 1e6),
+                batch=self._batch_ordinal)
         # batch-boundary commit (H5): offsets advance only after the
         # outputs for the whole batch are on MatchOut
         self.offset = recs[-1].offset + 1
@@ -1001,19 +1070,12 @@ class MatchService:
             out = self._lines_of(buf, line_off, msg_lines)
             self.journal.record_batch(out, reasons=reasons,
                                       offsets=offs, drops=[])
-            plan_us = int(plan_d * 1e6)
-            dev_us = int(dev_d * 1e6)
-            prod_us = int(self._last_produce_s * 1e6)
-            oids = wb.oid.tolist()
-            self.journal.record_latency(
-                [{"off": offs[i], "oid": int(oids[i]),
-                  "in_us": (max(0, fetch_us - atss[i])
-                            if atss[i] is not None else 0),
-                  "plan_us": plan_us, "dev_us": dev_us,
-                  "prod_us": prod_us,
-                  "e2e_us": (max(0, done_us - atss[i])
-                             if atss[i] is not None else 0)}
-                 for i in range(n)], batch=ordinal)
+        if n:
+            self._stamp_orders(
+                offs, wb.oid.tolist(), wb.aid.tolist(), atss,
+                fetch_us, done_us, int(plan_d * 1e6),
+                int(dev_d * 1e6), int(self._last_produce_s * 1e6),
+                batch=ordinal)
         self.offset = end_off
         if not self.follower:
             faults.kill_now("serve.kill", offset=self.offset)

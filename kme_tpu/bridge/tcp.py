@@ -27,6 +27,13 @@ re-sends the same stamp when it retries the same record across a
 reconnect, so ingress latency histograms include the reconnect delay
 (coordinated-omission-safe) instead of restarting the clock.
 
+Distributed tracing rides the same parse-by-length scheme: a produce
+request may carry a "tid" trace word (transport-advisory — see
+telemetry/dtrace.py; the durable log never stores it), and fetch rows
+for records carrying one gain a seventh element
+[o,k,v,epoch,out_seq,ats,tid] (ats padded with null when absent so the
+position is stable).
+
 **Binary framing (additive, auto-negotiated per message).** The server
 peeks one byte per request: '{' (0x7B) opens the JSON line above;
 0xB1 (wire.WIRE_MAGIC) opens a binary PRODUCE envelope — the 8-byte
@@ -39,7 +46,7 @@ the 72-byte order frames themselves. The reply is the usual JSON line
 buf[admitted*72:]. `fetch_bin` is the symmetric read path: a JSON
 request, answered by a JSON header line ({"ok":true,"n":N,
 "nbytes":B}) followed by B bytes of fixed-width rows — per record
-i64 offset/epoch/out_seq/ats (INT64_MIN = absent), u8 key-length
+i64 offset/epoch/out_seq/ats/tid (INT64_MIN = absent), u8 key-length
 (255 = null) + key, u32 value-length + value. Both paths carry the
 (epoch, out_seq) stamps and ats without a per-record dict on either
 side; JSON stays fully supported on the same socket (COMPAT.md).
@@ -74,7 +81,7 @@ from kme_tpu.wire import (FRAME_PRODUCE, WIRE_MAGIC, WIRE_VERSION,
 # docstring; the 8-byte header is wire.py's frame header)
 _ENV_HDR = struct.Struct("<BBBBI")
 _ENV_META = struct.Struct("<qqq")       # epoch, seq0, ats
-_REC_HDR = struct.Struct("<qqqq")       # offset, epoch, out_seq, ats
+_REC_HDR = struct.Struct("<qqqqq")      # offset, epoch, out_seq, ats, tid
 _I64_NONE = -(1 << 63)                  # "absent" for optional i64s
 _MAGIC_BYTE = bytes([WIRE_MAGIC])
 
@@ -90,8 +97,12 @@ def _unopt(v: int) -> Optional[int]:
 def _row(r: Record) -> list:
     """Wire row for a fetched record — the shortest shape that loses
     nothing: [o,k,v], +[epoch,out_seq] when stamped, +[ats] when the
-    broker recorded an admission time."""
+    broker recorded an admission time, +[tid] when the record carries a
+    trace word (ats stays in position 5, null when absent)."""
     ats = getattr(r, "ats", None)
+    tid = getattr(r, "tid", None)
+    if tid is not None:
+        return [r.offset, r.key, r.value, r.epoch, r.out_seq, ats, tid]
     if ats is not None:
         return [r.offset, r.key, r.value, r.epoch, r.out_seq, ats]
     if r.epoch is None and r.out_seq is None:
@@ -218,7 +229,8 @@ class _Handler(socketserver.StreamRequestHandler):
                                  req["value"],
                                  epoch=req.get("epoch"),
                                  out_seq=req.get("out_seq"),
-                                 ats=req.get("ats"))
+                                 ats=req.get("ats"),
+                                 tid=req.get("tid"))
             resp = {"ok": True, "offset": off}
         elif op == "produce_batch":
             # one round trip for a whole record batch — the bulk
@@ -250,7 +262,8 @@ class _Handler(socketserver.StreamRequestHandler):
                 parts.append(
                     _REC_HDR.pack(r.offset, _opt(r.epoch),
                                   _opt(r.out_seq),
-                                  _opt(getattr(r, "ats", None)))
+                                  _opt(getattr(r, "ats", None)),
+                                  _opt(getattr(r, "tid", None)))
                     + bytes([255 if r.key is None else len(kb)]) + kb
                     + struct.pack("<I", len(vb)) + vb)
             tail = b"".join(parts)
@@ -412,7 +425,8 @@ class TcpBroker:
 
     def produce(self, topic: str, key: Optional[str], value: str,
                 epoch: Optional[int] = None,
-                out_seq: Optional[int] = None) -> int:
+                out_seq: Optional[int] = None,
+                tid: Optional[int] = None) -> int:
         fp = ("produce", topic, key, value, epoch, out_seq)
         ats = self._ats_for(fp)
         req = {"op": "produce", "topic": topic, "key": key, "value": value,
@@ -421,6 +435,8 @@ class TcpBroker:
             req["epoch"] = epoch
         if out_seq is not None:
             req["out_seq"] = out_seq
+        if tid is not None:
+            req["tid"] = tid
         try:
             off = self._call(req)["offset"]
         except (BrokerOverload, BrokerFenced):
@@ -473,7 +489,8 @@ class TcpBroker:
         return [Record(row[0], row[1], row[2],
                        row[3] if len(row) > 3 else None,
                        row[4] if len(row) > 4 else None,
-                       row[5] if len(row) > 5 else None)
+                       row[5] if len(row) > 5 else None,
+                       row[6] if len(row) > 6 else None)
                 for row in resp["records"]]
 
     def fetch_bin(self, topic: str, offset: int, max_records: int = 1024,
@@ -489,7 +506,7 @@ class TcpBroker:
         recs: List[Record] = []
         off = 0
         for _ in range(int(resp["n"])):
-            o, epoch, out_seq, ats = _REC_HDR.unpack_from(body, off)
+            o, epoch, out_seq, ats, tid = _REC_HDR.unpack_from(body, off)
             off += _REC_HDR.size
             klen = body[off]
             off += 1
@@ -502,7 +519,8 @@ class TcpBroker:
             value = body[off:off + vlen].decode()
             off += vlen
             recs.append(Record(o, key, value, _unopt(epoch),
-                               _unopt(out_seq), _unopt(ats)))
+                               _unopt(out_seq), _unopt(ats),
+                               _unopt(tid)))
         return recs
 
     def end_offset(self, topic: str) -> int:

@@ -52,6 +52,12 @@ def loadgen_main(argv=None) -> int:
     p.add_argument("--report", default=None, metavar="PATH",
                    help="write a JSON run report (throughput, AIMD "
                         "rates, observed backoff_ms decay)")
+    p.add_argument("--trace-sample", type=int, default=10, metavar="N",
+                   help="--connections mode: keep the N slowest sends "
+                        "by RTT in the report, each with the "
+                        "deterministic client trace id it carried on "
+                        "the wire (dtrace.client_trace_id; resolve "
+                        "server-side with kme-trace)")
     args = p.parse_args(argv)
     if args.connections is not None and args.broker is None:
         p.error("--connections requires --broker")
@@ -129,6 +135,8 @@ def _loadgen_connections(args, msgs) -> int:
     from kme_tpu.bridge.provision import provision
     from kme_tpu.bridge.service import TOPIC_IN
     from kme_tpu.bridge.tcp import TcpBroker, parse_addr
+    from kme_tpu.telemetry.dtrace import (client_trace_id,
+                                          client_trace_ids)
     from kme_tpu.wire import dumps_order, encode_frames
 
     host, port = parse_addr(args.broker)
@@ -164,6 +172,25 @@ def _loadgen_connections(args, msgs) -> int:
         next_seq = 0
         sheds = dup = 0
         backoff_samples = []
+        # sampled tracing: every send carries a deterministic client
+        # trace id (pure mix of out_seq/aid/oid — replayable, never a
+        # clock); the N slowest RTTs keep theirs so a tail spike in
+        # this report resolves server-side via kme-trace
+        nslow = max(0, getattr(args, "trace_sample", 0))
+        slow = []
+
+        def note_slow(rtt_us, seq, m, tid, nrec):
+            if nslow == 0:
+                return
+            if len(slow) >= nslow and rtt_us <= slow[-1]["rtt_us"]:
+                return
+            slow.append({"rtt_us": int(rtt_us), "out_seq": int(seq),
+                         "aid": int(m.aid), "oid": int(m.oid),
+                         "records": int(nrec),
+                         "trace_id": f"0x{tid:016x}"})
+            slow.sort(key=lambda s: -s["rtt_us"])
+            del slow[nslow:]
+
         t0 = time.monotonic()
         while True:
             active = np.flatnonzero(remaining > 0)
@@ -186,18 +213,30 @@ def _loadgen_connections(args, msgs) -> int:
                 now = time.monotonic() - t0
                 try:
                     if args.binary:
-                        buf = encode_frames(batch)
+                        tids = client_trace_ids(
+                            seq0, [m.aid for m in batch],
+                            [m.oid for m in batch])
+                        buf = encode_frames(batch, tids=tids)
+                        bt = time.monotonic()
                         n, _ = call_rt(cli.produce_frames, TOPIC_IN,
                                        None, buf, epoch=args.epoch,
                                        seq0=seq0)
+                        note_slow((time.monotonic() - bt) * 1e6,
+                                  seq0, batch[0], tids[0], k)
                         dup += k - n    # transport-retry suppressions
                         ok_n = k
                     else:
                         for m in batch:
+                            tid = client_trace_id(seq0 + sent,
+                                                  m.aid, m.oid)
+                            bt = time.monotonic()
                             r = call_rt(cli.produce, TOPIC_IN, None,
                                         dumps_order(m),
                                         epoch=args.epoch,
-                                        out_seq=seq0 + sent)
+                                        out_seq=seq0 + sent,
+                                        tid=tid)
+                            note_slow((time.monotonic() - bt) * 1e6,
+                                      seq0 + sent, m, tid, 1)
                             if r == -1:
                                 dup += 1
                             sent += 1
@@ -248,6 +287,11 @@ def _loadgen_connections(args, msgs) -> int:
         "backoff_ms_samples": backoff_samples[:1000],
         "backoff_ms_max": max(hints) if hints else None,
         "backoff_ms_last": hints[-1] if hints else None,
+        # slowest sends observed client-side; the binary path samples
+        # per batch ("records" > 1), JSON per record — either way the
+        # trace id matches what the broker recorded, so
+        # `kme-trace --cluster --order AID:OID` shows the server half
+        "slow_samples": slow,
     }
     if args.report:
         with open(args.report, "w") as f:
@@ -395,6 +439,102 @@ def _trace_self_check() -> int:
     return 0 if ok else 1
 
 
+def _trace_cluster(args) -> int:
+    """kme-trace --cluster: stitch per-order waterfalls across front,
+    groups, transfer legs and merge from a multi-leader run directory
+    (telemetry/dtrace.py). Exit 0 iff every admitted order stitched to
+    a complete waterfall."""
+    import json
+
+    from kme_tpu.telemetry import dtrace
+
+    doc = dtrace.stitch_state_root(args.state_root,
+                                   input_path=args.input,
+                                   prefund=args.prefund)
+    if args.chrome_out is not None:
+        with open(args.chrome_out, "w") as f:
+            json.dump(dtrace.chrome_trace_doc(doc), f)
+        print(f"kme-trace: Chrome trace written to {args.chrome_out}",
+              file=sys.stderr)
+    if args.order is not None:
+        o = dtrace.find_order(doc, args.order)
+        if o is None:
+            print(f"kme-trace: no stitched order matches "
+                  f"{args.order!r}", file=sys.stderr)
+            return 1
+        print(dtrace.waterfall_text(o))
+        return 0
+    orders = doc["orders"]
+    if args.json:
+        for o in orders[:args.limit] if args.limit else orders:
+            print(json.dumps(o, sort_keys=True))
+    elif args.limit:
+        for o in orders[:args.limit]:
+            print(dtrace.waterfall_text(o))
+            print()
+    frac = (doc["stitched"] / doc["admitted"]) if doc["admitted"] else 0
+    legs = sum(len(o["legs"]) for o in orders)
+    print(f"kme-trace: {doc['admitted']} orders admitted across "
+          f"{doc['groups']} groups, {doc['stitched']} stitched "
+          f"({frac:.2%}), {legs} transfer/broadcast legs linked, "
+          f"counters={doc['counters']}", file=sys.stderr)
+    return 0 if doc["admitted"] and doc["stitched"] == doc["admitted"] \
+        else (1 if doc["admitted"] else 2)
+
+
+def agg_main(argv=None) -> int:
+    """Cluster SLO plane: aggregate the front's and every group's
+    /metrics.json into cluster-wide end-to-end latency (exact merged
+    quantiles from raw histogram buckets), global SLO burn rate, a
+    per-group health table, and p99 exemplars that resolve to
+    waterfalls via kme-trace --cluster --order AID:OID."""
+    p = argparse.ArgumentParser(prog="kme-agg",
+                                description=agg_main.__doc__)
+    p.add_argument("sources", nargs="*", metavar="URL|PATH",
+                   help="metrics sources: http://host:port endpoints "
+                        "(scraped via /metrics.json), heartbeat files, "
+                        "or saved snapshot JSON files")
+    p.add_argument("--state-root", default=None, metavar="DIR",
+                   help="discover group health surfaces under a "
+                        "multi-leader run dir (top.discover_endpoints) "
+                        "and scrape those too")
+    p.add_argument("--slo-ms", type=float, default=None, metavar="MS",
+                   help="cluster e2e SLO threshold; reports the global "
+                        "burn rate against --slo-target")
+    p.add_argument("--slo-target", type=float, default=0.999,
+                   help="SLO attainment target (default 0.999)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full aggregate document as JSON")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="also write the aggregate JSON here")
+    args = p.parse_args(argv)
+    import json
+
+    from kme_tpu.telemetry import dtrace
+    from kme_tpu.telemetry.top import discover_endpoints, scrape
+
+    sources = list(args.sources)
+    if args.state_root:
+        eps = discover_endpoints(args.state_root)
+        sources.extend(g["health"] for g in eps["groups"])
+    if not sources:
+        p.error("no sources: give URLs/paths or --state-root")
+    snaps = []
+    for src in sources:
+        node = scrape(src)      # same path as kme-top: never raises
+        snaps.append((src, node["metrics"] if node["ok"] else None))
+    doc = dtrace.aggregate(snaps, slo_ms=args.slo_ms,
+                           slo_target=args.slo_target)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    else:
+        print(dtrace.render_agg(doc))
+    return 0 if any(s for _n, s in snaps) else 1
+
+
 def trace_main(argv=None) -> int:
     """Flight-recorder query tool: reconstruct one order's or account's
     lifecycle from a journal written by kme-serve --journal-out (or
@@ -405,10 +545,11 @@ def trace_main(argv=None) -> int:
     p.add_argument("journal", nargs="?", default=None,
                    help="journal path (.jsonl or .bin/.kmej; rotated "
                         "PATH.N siblings are read automatically)")
-    p.add_argument("--order", type=int, default=None, metavar="OID",
+    p.add_argument("--order", default=None, metavar="OID|AID:OID",
                    help="print every event touching this order id "
                         "(taker or resting maker side) plus a terminal-"
-                        "state summary")
+                        "state summary; with --cluster, AID:OID (or a "
+                        "trace id) selects the per-order waterfall")
     p.add_argument("--account", type=int, default=None, metavar="AID",
                    help="print every event touching this account id")
     p.add_argument("--limit", type=int, default=None, metavar="N",
@@ -440,11 +581,33 @@ def trace_main(argv=None) -> int:
                    help="synthetic round-trip smoke test (no journal "
                         "needed); exit 0 iff journal/oracle/lifecycle "
                         "machinery agrees")
+    p.add_argument("--cluster", action="store_true",
+                   help="stitch cluster-wide per-order waterfalls from "
+                        "a multi-leader run dir (--state-root): merges "
+                        "every group's journal spans with the "
+                        "deterministic front routing (transfer legs "
+                        "linked parent/child, failover replay deduped)")
+    p.add_argument("--state-root", default=None, metavar="DIR",
+                   help="--cluster: run dir with group{k}/ children "
+                        "(the kme-chaos shard-failover layout)")
+    p.add_argument("--input", default=None, metavar="PATH",
+                   help="--cluster: the front's global input stream "
+                        "(default <state-root>/front.in)")
+    p.add_argument("--prefund", type=int, default=8,
+                   help="--cluster: the front's --prefund (the routing "
+                        "re-run must match the original split)")
+    p.add_argument("--chrome-out", default=None, metavar="PATH",
+                   help="--cluster: write a Chrome trace-event JSON "
+                        "(flow arrows across groups) here")
     args = p.parse_args(argv)
     import json
 
     if args.self_check:
         return _trace_self_check()
+    if args.cluster:
+        if args.state_root is None:
+            p.error("--cluster needs --state-root")
+        return _trace_cluster(args)
     if args.replay_repro is not None:
         from kme_tpu.telemetry.audit import replay_repro
 
@@ -485,8 +648,13 @@ def trace_main(argv=None) -> int:
             print(f"  oracle:  {want[div]}", file=sys.stderr)
         return 1
     if args.order is not None:
-        picked = order_lifecycle(events, args.order)
-        summary = lifecycle_summary(picked, args.order)
+        try:
+            oid = int(args.order)
+        except ValueError:
+            p.error("--order takes AID:OID only with --cluster; "
+                    "on a single journal give the integer OID")
+        picked = order_lifecycle(events, oid)
+        summary = lifecycle_summary(picked, oid)
     elif args.account is not None:
         picked = account_history(events, args.account)
         summary = None
@@ -574,7 +742,7 @@ def main(argv=None) -> int:
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
         "supervise", "standby", "trace", "chaos", "top", "lint",
-        "front"))
+        "front", "agg"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
@@ -584,6 +752,7 @@ def main(argv=None) -> int:
             "supervise": supervise_main, "standby": standby_main,
             "trace": trace_main, "chaos": chaos_main,
             "top": top_main, "lint": lint_main, "front": front_main,
+            "agg": agg_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

@@ -263,6 +263,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "kme_parse_col": ([c.c_void_p, c.c_int32], P64),
         "kme_parse_hnext": ([c.c_void_p], c.POINTER(c.c_uint8)),
         "kme_parse_hprev": ([c.c_void_p], c.POINTER(c.c_uint8)),
+        "kme_parse_tid": ([c.c_void_p], P64),
+        "kme_parse_htid": ([c.c_void_p], c.POINTER(c.c_uint8)),
         # binary order frames + canonical-JSON emission (kme_wire.cpp)
         "kme_parse_frames": ([c.c_void_p, c.c_char_p, c.c_int64],
                              c.c_int64),
@@ -284,6 +286,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "kme_front_col": ([c.c_void_p, c.c_int32], P64),
         "kme_front_hnext": ([c.c_void_p], c.POINTER(c.c_uint8)),
         "kme_front_hprev": ([c.c_void_p], c.POINTER(c.c_uint8)),
+        "kme_front_tid": ([c.c_void_p], P64),
+        "kme_front_htid": ([c.c_void_p], c.POINTER(c.c_uint8)),
         "kme_front_json": ([c.c_void_p], c.c_int64),
         "kme_front_json_buf": ([c.c_void_p], c.c_void_p),
         "kme_front_json_off": ([c.c_void_p], P64),

@@ -29,6 +29,8 @@ int64_t kme_parse_err_off(void*);
 const int64_t* kme_parse_col(void*, int32_t);
 const uint8_t* kme_parse_hnext(void*);
 const uint8_t* kme_parse_hprev(void*);
+const int64_t* kme_parse_tid(void*);
+const uint8_t* kme_parse_htid(void*);
 int64_t kme_parse_emit(void*);
 const char* kme_parse_emit_buf(void*);
 const int64_t* kme_parse_emit_off(void*);
@@ -122,6 +124,12 @@ const uint8_t* kme_front_hnext(void* p) {
 }
 const uint8_t* kme_front_hprev(void* p) {
   return kme_parse_hprev(static_cast<Front*>(p)->parse);
+}
+const int64_t* kme_front_tid(void* p) {
+  return kme_parse_tid(static_cast<Front*>(p)->parse);
+}
+const uint8_t* kme_front_htid(void* p) {
+  return kme_parse_htid(static_cast<Front*>(p)->parse);
 }
 // Canonical-JSON emission for the accepted rows (broker value bytes);
 // delegates to the pinned kme_wire.cpp emitter.

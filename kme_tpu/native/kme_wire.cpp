@@ -303,6 +303,8 @@ struct Parse {
   int64_t* cols[8] = {};  // action oid aid sid price size next prev
   uint8_t* hnext = nullptr;
   uint8_t* hprev = nullptr;
+  int64_t* tidcol = nullptr;  // transport-advisory trace word (FLAG_TID)
+  uint8_t* htid = nullptr;
   int64_t cap = 0, n = 0;
   int64_t err_off = 0;       // byte offset of the frame that failed
   Recon emit;                // canonical-JSON emission scratch
@@ -312,6 +314,8 @@ struct Parse {
     for (auto* c : cols) delete[] c;
     delete[] hnext;
     delete[] hprev;
+    delete[] tidcol;
+    delete[] htid;
     delete[] emit_off;
   }
 };
@@ -324,8 +328,12 @@ inline void parse_reserve(Parse& P, int64_t n) {
   }
   delete[] P.hnext;
   delete[] P.hprev;
+  delete[] P.tidcol;
+  delete[] P.htid;
   P.hnext = new uint8_t[n];
   P.hprev = new uint8_t[n];
+  P.tidcol = new int64_t[n];
+  P.htid = new uint8_t[n];
   P.cap = n;
 }
 
@@ -399,6 +407,12 @@ const uint8_t* kme_parse_hnext(void* p) {
 const uint8_t* kme_parse_hprev(void* p) {
   return static_cast<Parse*>(p)->hprev;
 }
+const int64_t* kme_parse_tid(void* p) {
+  return static_cast<Parse*>(p)->tidcol;
+}
+const uint8_t* kme_parse_htid(void* p) {
+  return static_cast<Parse*>(p)->htid;
+}
 
 // Parse `len` bytes of newline-separated order JSON. Returns the line
 // count on success, -(line+1) on the first line outside the fast
@@ -424,6 +438,8 @@ int64_t kme_parse_lines(void* handle, const char* buf, int64_t len) {
       for (int f = 0; f < 8; f++) P.cols[f][li] = v[f];
       P.hnext[li] = has[6];
       P.hprev[li] = has[7];
+      P.tidcol[li] = 0;
+      P.htid[li] = 0;
       P.n++;
       p = end < bend ? end + 1 : end;
       continue;
@@ -506,6 +522,8 @@ int64_t kme_parse_lines(void* handle, const char* buf, int64_t len) {
     for (int f = 0; f < 8; f++) P.cols[f][li] = v[f];
     P.hnext[li] = has[6];
     P.hprev[li] = has[7];
+    P.tidcol[li] = 0;
+    P.htid[li] = 0;
     P.n++;
   }
   return P.n;
@@ -530,7 +548,11 @@ int64_t kme_parse_err_off(void* p) {
 // wire._check_frame_header exactly — the Python caller re-raises
 // through the Python authority so the surfaced error is identical.
 int64_t kme_parse_frames(void* handle, const uint8_t* buf, int64_t len) {
+  // Flags bit 2 (FLAG_TID) extends the frame by a trailing int64 trace
+  // word: 80 bytes instead of 72. The word is transport-advisory — it
+  // never reaches the canonical JSON emission (kme_parse_emit).
   constexpr int64_t FRAME_SIZE = 72, FRAME_HDR = 8;
+  constexpr int64_t FRAME_SIZE_TRACED = 80;
   Parse& P = *static_cast<Parse*>(handle);
   parse_reserve(P, len / FRAME_SIZE + 1);
   P.n = 0;
@@ -544,16 +566,25 @@ int64_t kme_parse_frames(void* handle, const uint8_t* buf, int64_t len) {
     if (b[0] != 0xB1) return -2;
     if (b[1] != 1) return -3;
     if (b[2] != 0) return -4;
+    const bool traced = (b[3] & 4) != 0;
+    const int64_t expected = traced ? FRAME_SIZE_TRACED : FRAME_SIZE;
     uint32_t length;
     std::memcpy(&length, b + 4, 4);
-    if (length != FRAME_SIZE) return -5;
-    if (rem < FRAME_SIZE) return -1;
+    if (length != expected) return -5;
+    if (rem < expected) return -1;
     int64_t v[8];
     std::memcpy(v, b + 8, 64);
     for (int f = 0; f < 8; f++) P.cols[f][i] = v[f];
     P.hnext[i] = b[3] & 1;
     P.hprev[i] = (b[3] >> 1) & 1;
-    off += FRAME_SIZE;
+    if (traced) {
+      std::memcpy(&P.tidcol[i], b + FRAME_SIZE, 8);
+      P.htid[i] = 1;
+    } else {
+      P.tidcol[i] = 0;
+      P.htid[i] = 0;
+    }
+    off += expected;
     i++;
   }
   P.n = i;

@@ -20,7 +20,7 @@ the operator wants it.
 Event dictionaries (canonical keys; absent keys mean not-applicable):
 
   e    event type: submit accept reject rest fill cancel create
-       transfer payout add_symbol remove_symbol drop win lat
+       transfer payout add_symbol remove_symbol drop win lat span
   seq  engine-global event sequence number (monotonic, survives resume)
   ts   wall clock, microseconds since epoch
   b    batch id (monotonic per journal)
@@ -41,6 +41,10 @@ Event dictionaries (canonical keys; absent keys mean not-applicable):
        arrival->visible total (ingress is per-order from the broker
        arrival stamp; plan/device/produce are the enclosing batch's
        stage walls — every order in a batch shares them)
+  kind/tid/ptid/t0/t1/g/li   on span (distributed-tracing) events:
+       SPAN_KINDS stage name, deterministic trace id (+ parent trace
+       id for XFER legs), wall-clock span bounds in microseconds,
+       group ordinal and front-local row index (telemetry/dtrace.py)
 
 `batch_events` is the single wire->events derivation; the oracle replay
 (`oracle_events`) reuses it on the Python reference engine's output so a
@@ -62,8 +66,16 @@ from kme_tpu.wire import (REJ_MALFORMED, REJ_UNSPECIFIED, parse_order,
 
 ETYPES = ("submit", "accept", "reject", "rest", "fill", "cancel",
           "create", "transfer", "payout", "add_symbol", "remove_symbol",
-          "drop", "win", "lat")
+          "drop", "win", "lat", "span")
 _ETYPE_IDX = {n: i for i, n in enumerate(ETYPES)}
+
+# distributed-tracing span kinds (telemetry/dtrace.py): the per-hop
+# stages a cluster waterfall is stitched from. Order is the wire
+# encoding (rej byte in the binary record) — append-only.
+SPAN_KINDS = ("front_accept", "route", "ingress", "plan", "device",
+              "produce", "xfer_reserve", "xfer_settle", "merge",
+              "consume")
+_SPAN_IDX = {n: i for i, n in enumerate(SPAN_KINDS)}
 
 _ACT_EVENT = {
     op.CANCEL: "cancel",
@@ -153,7 +165,7 @@ def canonical_events(events: Iterable[dict]) -> List[dict]:
     byte-for-byte."""
     out = []
     for ev in events:
-        if ev.get("e") in ("win", "lat"):
+        if ev.get("e") in ("win", "lat", "span"):
             continue
         out.append({k: v for k, v in ev.items()
                     if k not in ("seq", "ts", "b", "i", "sh", "rej")})
@@ -222,6 +234,16 @@ def _encode(ev: dict) -> bytes:
             ev.get("oid", 0), ev.get("in_us", 0), ev.get("plan_us", 0),
             ev.get("dev_us", 0), ev.get("prod_us", 0),
             ev.get("e2e_us", 0), 0)
+    if ev["e"] == "span":
+        # trace span: kind index in the rej byte, group in act, and the
+        # spare q-slots carry tid/ptid/t0/t1/aid/li — same framing, no
+        # version bump (mirrors the "lat" precedent above)
+        return _REC.pack(
+            e, _SPAN_IDX[ev["kind"]], ev.get("sh", 0), 0,
+            ev.get("g", -1), ev.get("b", -1), -1, ev.get("seq", 0),
+            ev.get("ts", 0), ev.get("off", -1), ev.get("oid", 0),
+            ev.get("tid", 0), ev.get("ptid", 0), ev.get("t0", 0),
+            ev.get("t1", 0), ev.get("aid", 0), ev.get("li", -1))
     return _REC.pack(
         e, ev.get("rej", 0), ev.get("sh", 0), 0, ev.get("act", 0),
         ev.get("b", 0), ev.get("i", -1), ev.get("seq", 0),
@@ -241,6 +263,10 @@ def _decode(buf: bytes) -> dict:
     if name == "lat":
         ev.update(off=off, oid=oid, in_us=aid, plan_us=sid,
                   dev_us=px, prod_us=qty, e2e_us=moid)
+        return ev
+    if name == "span":
+        ev.update(kind=SPAN_KINDS[rej], g=act, off=off, oid=oid,
+                  tid=aid, ptid=sid, t0=px, t1=qty, aid=moid, li=maid)
         return ev
     ev.update(i=i, off=off)
     if name == "drop":
@@ -442,6 +468,16 @@ class Journal:
                -1 if batch is None else batch)
         self._submit(job, REC_SIZE * len(entries))
 
+    def record_spans(self, entries: Sequence[dict],
+                     batch: Optional[int] = None) -> None:
+        """Append distributed-tracing "span" events (kind/off/oid/aid/
+        tid/ptid/t0/t1/g/li — see SPAN_KINDS and telemetry/dtrace.py).
+        Like "lat", spans are excluded from the canonical form: the
+        lifecycle stream `kme-trace --verify` replays is untouched."""
+        job = ("span", tuple(dict(e) for e in entries),
+               -1 if batch is None else batch)
+        self._submit(job, REC_SIZE * len(entries))
+
     def append_events(self, events: List[dict]) -> None:
         """Stamp + append pre-derived events (one batch's worth)."""
         job = ("events", events)
@@ -500,6 +536,9 @@ class Journal:
             elif job[0] == "lat":
                 _, entries, b = job
                 events = [dict(ev, e="lat") for ev in entries]
+            elif job[0] == "span":
+                _, entries, b = job
+                events = [dict(ev, e="span") for ev in entries]
             else:
                 _, events = job
                 b = self._batch

@@ -227,6 +227,10 @@ class Registry:
         self.namespace = namespace
         self._lock = threading.Lock()
         self._metrics: dict = {}  # insertion-ordered
+        # p99 exemplars: slowest recent orders as {tid, off, oid, aid,
+        # e2e_us} dicts (deterministic trace ids — telemetry/dtrace.py)
+        # so a cluster-level quantile outlier resolves to a waterfall
+        self._exemplars: list = []
 
     def _get(self, cls, name: str, help: str):
         with self._lock:
@@ -250,6 +254,16 @@ class Registry:
 
     def latency(self, name: str, help: str = "") -> LatencyHistogram:
         return self._get(LatencyHistogram, name, help)
+
+    def set_exemplars(self, exemplars) -> None:
+        """Replace the slow-order exemplar list exported in snapshot()
+        (bounded upstream; the registry stores what it is given)."""
+        with self._lock:
+            self._exemplars = list(exemplars)
+
+    def exemplars(self) -> list:
+        with self._lock:
+            return list(self._exemplars)
 
     # -- bulk publication (the session metrics()/histograms() projection)
 
@@ -313,6 +327,8 @@ class Registry:
         with self._lock:
             out = {"counters": {}, "gauges": {}, "histograms": {},
                    "latencies": {}}
+            if self._exemplars:
+                out["exemplars"] = list(self._exemplars)
             for name, m in self._metrics.items():
                 if m.kind == "counter":
                     out["counters"][name] = m.value
@@ -331,6 +347,11 @@ class Registry:
                             counts, count, 0.99) * 1e3, 3),
                         "p999_ms": round(m._quantile_from(
                             counts, count, 0.999) * 1e3, 3),
+                        # raw bucket counts (LAT_BOUNDS layout): the
+                        # cluster aggregator (kme-agg) sums these across
+                        # scrapes, so merged quantiles are EXACT — not a
+                        # quantile-of-quantiles estimate
+                        "buckets": counts,
                     }
                 else:
                     out["histograms"][name] = {

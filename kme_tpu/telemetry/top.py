@@ -79,11 +79,56 @@ def read_supervisor(path: Optional[str]) -> Optional[dict]:
         return None
 
 
+def discover_endpoints(state_root: str) -> dict:
+    """Endpoint discovery for a state directory — the ONE place the
+    conventional file names live (kme-agg and the --cluster view share
+    it; the single-pair names used to be hardcoded in main()).
+
+    A plain checkpoint dir yields the leader/standby/supervisor trio;
+    a multi-leader run dir (chaos layout: `group{k}/state/...`) also
+    yields one row per group. Paths are returned whether or not the
+    files exist yet — scrape() degrades unreachable sources instead of
+    dying."""
+    import os
+
+    eps: dict = {
+        "leader": os.path.join(state_root, "serve.health"),
+        "standby": os.path.join(state_root, "standby.health"),
+        "supervisor": os.path.join(state_root, "supervisor.json"),
+        "groups": [],
+    }
+    try:
+        names = sorted(os.listdir(state_root))
+    except OSError:
+        names = []
+    for name in names:
+        if name.startswith("group") and name[5:].isdigit():
+            st = os.path.join(state_root, name, "state")
+            eps["groups"].append({
+                "k": int(name[5:]),
+                "health": os.path.join(st, "serve.health"),
+                "supervisor": os.path.join(st, "supervisor.json"),
+            })
+    return eps
+
+
 def collect(leader: Optional[str], standby: Optional[str],
             supervisor: Optional[str], now: Optional[float] = None) -> dict:
     return {"t": time.monotonic() if now is None else now,
             "leader": scrape(leader), "standby": scrape(standby),
             "supervisor": read_supervisor(supervisor)}
+
+
+def collect_cluster(groups, now: Optional[float] = None) -> dict:
+    """One scrape sweep over a discovered group list — every row goes
+    through the same scrape() path as the single-pair view."""
+    rows = []
+    for g in groups:
+        rows.append({"k": g["k"], "node": scrape(g.get("health")),
+                     "supervisor": read_supervisor(
+                         g.get("supervisor"))})
+    return {"t": time.monotonic() if now is None else now,
+            "rows": rows}
 
 
 # -- derivation --------------------------------------------------------
@@ -296,6 +341,53 @@ def render(view: dict, width: int = 78) -> list:
     return lines
 
 
+def render_cluster(cur: dict, prev: Optional[dict] = None,
+                   width: int = 78) -> list:
+    """Multi-leader frame: one row per shard group (rate from the
+    previous sweep's counters), DEGRADED rows for groups whose health
+    surface is unreachable instead of a crash or a silent hole."""
+    bar = "=" * width
+    lines = [f"kme-top --cluster  {time.strftime('%H:%M:%S')}", bar,
+             f"  {'group':<7s}{'epoch':>6s}{'offset':>10s}"
+             f"{'rate/s':>10s}{'e2e p99':>10s}{'lag':>8s}"
+             f"{'shed':>8s}{'restarts':>9s}"]
+    prev_rows = {r["k"]: r for r in (prev or {}).get("rows", ())}
+    dt = (cur["t"] - prev["t"]) if prev else 0.0
+    up = 0
+    for row in cur["rows"]:
+        k, node = row["k"], row["node"]
+        if not node["ok"]:
+            lines.append(f"  g{k:<6d} DEGRADED (unreachable: "
+                         f"{node.get('error', 'no source')})")
+            continue
+        up += 1
+        hb = node.get("hb") or {}
+        rate = None
+        p = prev_rows.get(k)
+        if p is not None and p["node"]["ok"] and dt > 0:
+            a = _counter(p["node"], "service_records")
+            b = _counter(node, "service_records")
+            if a is not None and b is not None and b >= a:
+                rate = (b - a) / dt
+        lats = node.get("metrics", {}).get("latencies", {})
+        p99 = (lats.get("lat_e2e") or {}).get("p99_ms")
+        lag = _gauge(node, f"group{k}_lag")
+        shed = _gauge(node, "overload_rejects")
+        sup = row.get("supervisor") or {}
+        lines.append(
+            f"  g{k:<6d}"
+            f"{_fmt(hb.get('epoch', _gauge(node, 'leader_epoch')), 0):>6s}"
+            f"{_fmt(hb.get('offset', _gauge(node, 'service_offset')), 0):>10s}"
+            f"{_fmt(rate, 0):>10s}"
+            f"{_fmt(p99, 3):>10s}"
+            f"{_fmt(lag, 0):>8s}"
+            f"{_fmt(shed, 0):>8s}"
+            f"{_fmt(sup.get('restarts_total'), 0):>9s}")
+    lines.append(bar)
+    lines.append(f"  {up}/{len(cur['rows'])} groups up")
+    return lines
+
+
 # -- entry point -------------------------------------------------------
 
 
@@ -340,9 +432,14 @@ def main(argv=None) -> int:
                    help="supervisor state mirror "
                         "(<checkpoint-dir>/supervisor.json)")
     p.add_argument("--state-root", default=None, metavar="DIR",
-                   help="convenience: a checkpoint dir; fills in "
-                        "--leader/--standby/--supervisor from the "
-                        "conventional file names inside it")
+                   help="convenience: a checkpoint dir (or a multi-"
+                        "leader run dir with group{k}/ children); "
+                        "fills in --leader/--standby/--supervisor via "
+                        "discover_endpoints")
+    p.add_argument("--cluster", action="store_true",
+                   help="multi-leader view: one row per discovered "
+                        "shard group under --state-root (degraded "
+                        "rows for unreachable groups)")
     p.add_argument("--interval", type=float, default=1.0,
                    metavar="SECS")
     p.add_argument("--once", action="store_true",
@@ -351,15 +448,34 @@ def main(argv=None) -> int:
     p.add_argument("--no-rate-sample", action="store_true",
                    help="with --once: single sample, no rate")
     args = p.parse_args(argv)
+    eps = None
     if args.state_root:
-        import os
-
-        args.leader = args.leader or os.path.join(
-            args.state_root, "serve.health")
-        args.standby = args.standby or os.path.join(
-            args.state_root, "standby.health")
-        args.supervisor = args.supervisor or os.path.join(
-            args.state_root, "supervisor.json")
+        eps = discover_endpoints(args.state_root)
+        args.leader = args.leader or eps["leader"]
+        args.standby = args.standby or eps["standby"]
+        args.supervisor = args.supervisor or eps["supervisor"]
+    if args.cluster:
+        if eps is None or not eps["groups"]:
+            p.error("--cluster needs --state-root pointing at a run "
+                    "dir with group{k}/ children")
+        prev = None
+        if args.once and not args.no_rate_sample:
+            prev = collect_cluster(eps["groups"])
+            time.sleep(min(args.interval, 1.0))
+        if args.once:
+            for ln in render_cluster(collect_cluster(eps["groups"]),
+                                     prev):
+                print(ln)
+            return 0
+        try:
+            while True:
+                cur = collect_cluster(eps["groups"])
+                for ln in render_cluster(cur, prev):
+                    print(ln)
+                prev = cur
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
     if not (args.leader or args.standby or args.supervisor):
         p.error("nothing to watch: give --leader/--standby/"
                 "--supervisor or --state-root")

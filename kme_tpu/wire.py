@@ -46,24 +46,38 @@ _FIELDS = ("action", "oid", "aid", "sid", "price", "size")
 #                     TCP produce envelope (bridge/tcp.py) — same
 #                     header so one validator covers both
 #   3   1    flags    bit0 next present, bit1 prev present (the
-#                     nullable POJO pointer fields, quirk Q9)
-#   4   4    length   total frame bytes (= FRAME_SIZE for kind 0) —
-#                     the length prefix; a mismatch is rejected before
+#                     nullable POJO pointer fields, quirk Q9); bit2
+#                     trace word present (ISSUE 12): the frame carries
+#                     one trailing int64 — the deterministic per-order
+#                     trace id (telemetry/dtrace.py) — and its length
+#                     prefix is FRAME_SIZE_TRACED
+#   4   4    length   total frame bytes (= FRAME_SIZE for kind 0, or
+#                     FRAME_SIZE_TRACED when flags bit2 is set) — the
+#                     length prefix; a mismatch is rejected before
 #                     any field is read, so a corrupt/oversized prefix
 #                     can never walk the decoder off the buffer
 #   8   64   action oid aid sid price size next prev, int64 each
+#   72  8    trace id (int64) — ONLY when flags bit2 is set
 #
 # The admitted VALUE is unchanged: a binary frame decodes to the exact
 # OrderMsg its JSON twin parses to, and the broker stores the canonical
 # Jackson line (order_json) — durable logs, oracle replay and MatchOut
-# bytes cannot tell which encoding carried a record.
+# bytes cannot tell which encoding carried a record. The trace word is
+# transport-additive the same way the (epoch, out_seq) stamps are: it
+# rides ALONGSIDE the record (broker.Record.tid), never inside the
+# stored value, so tracing on/off cannot change a durable byte.
 
 WIRE_MAGIC = 0xB1
 WIRE_VERSION = 1
 FRAME_ORDER = 0
 FRAME_PRODUCE = 2      # TCP request envelope kind (bridge/tcp.py)
+FLAG_NEXT = 1
+FLAG_PREV = 2
+FLAG_TID = 4           # trace word present (+8 byte frame)
 _FRAME = struct.Struct("<BBBBI8q")
 FRAME_SIZE = _FRAME.size          # 72
+_TID_WORD = struct.Struct("<q")
+FRAME_SIZE_TRACED = FRAME_SIZE + _TID_WORD.size   # 80
 _FRAME_HDR = struct.Struct("<BBBBI")
 
 
@@ -80,23 +94,33 @@ class WireFrameError(ValueError):
         self.code = REJ_MALFORMED
 
 
-def encode_frame(m: "OrderMsg") -> bytes:
-    """One OrderMsg -> one 72-byte binary frame. Values beyond int64
-    raise (struct.error is a ValueError subclass here via OverflowError
+def encode_frame(m: "OrderMsg", tid: Optional[int] = None) -> bytes:
+    """One OrderMsg -> one 72-byte binary frame (80 with a trace id:
+    flags bit2 + trailing int64). Values beyond int64 raise
+    (struct.error is a ValueError subclass here via OverflowError
     semantics) — callers stay on the JSON path, which carries arbitrary
     ints."""
-    flags = (1 if m.next is not None else 0) | \
-            (2 if m.prev is not None else 0)
+    flags = (FLAG_NEXT if m.next is not None else 0) | \
+            (FLAG_PREV if m.prev is not None else 0)
+    length, tail = FRAME_SIZE, b""
+    if tid is not None:
+        flags |= FLAG_TID
+        length = FRAME_SIZE_TRACED
+        tail = _TID_WORD.pack(tid)
     return _FRAME.pack(WIRE_MAGIC, WIRE_VERSION, FRAME_ORDER, flags,
-                       FRAME_SIZE, m.action, m.oid, m.aid, m.sid,
+                       length, m.action, m.oid, m.aid, m.sid,
                        m.price, m.size,
                        0 if m.next is None else m.next,
-                       0 if m.prev is None else m.prev)
+                       0 if m.prev is None else m.prev) + tail
 
 
-def encode_frames(msgs) -> bytes:
-    """OrderMsg sequence -> one contiguous buffer of binary frames."""
-    return b"".join(encode_frame(m) for m in msgs)
+def encode_frames(msgs, tids=None) -> bytes:
+    """OrderMsg sequence -> one contiguous buffer of binary frames.
+    `tids` (parallel sequence, None entries allowed) attaches the
+    per-order trace words."""
+    if tids is None:
+        return b"".join(encode_frame(m) for m in msgs)
+    return b"".join(encode_frame(m, t) for m, t in zip(msgs, tids))
 
 
 def _check_frame_header(buf, off: int, remaining: int) -> int:
@@ -107,7 +131,7 @@ def _check_frame_header(buf, off: int, remaining: int) -> int:
         raise WireFrameError(
             "truncated", f"{remaining} byte(s) at offset {off}, header "
             f"needs {_FRAME_HDR.size}")
-    magic, version, kind, _flags, length = _FRAME_HDR.unpack_from(
+    magic, version, kind, flags, length = _FRAME_HDR.unpack_from(
         buf, off)
     if magic != WIRE_MAGIC:
         raise WireFrameError(
@@ -121,28 +145,41 @@ def _check_frame_header(buf, off: int, remaining: int) -> int:
         raise WireFrameError(
             "bad_kind", f"kind {kind} at offset {off} (expected "
             f"{FRAME_ORDER})")
-    if length != FRAME_SIZE:
+    expected = FRAME_SIZE_TRACED if flags & FLAG_TID else FRAME_SIZE
+    if length != expected:
         raise WireFrameError(
             "bad_length", f"length prefix {length} at offset {off} "
-            f"(order frames are exactly {FRAME_SIZE} bytes)")
-    if remaining < FRAME_SIZE:
+            f"(order frames are exactly {expected} bytes with these "
+            f"flags)")
+    if remaining < expected:
         raise WireFrameError(
             "truncated", f"{remaining} byte(s) at offset {off}, frame "
-            f"declares {FRAME_SIZE}")
-    return FRAME_SIZE
+            f"declares {expected}")
+    return expected
+
+
+def decode_frame_tid(buf, off: int = 0
+                     ) -> Tuple["OrderMsg", Optional[int], int]:
+    """Decode one frame at `off`; returns (msg, trace_id_or_None,
+    next_offset). THE Python authority for the frame format — the
+    native acceptor (kme_front.cpp) and the numpy batch path
+    (parse_frames) are pinned byte-exact against it by
+    tests/test_wire_fuzz.py."""
+    flen = _check_frame_header(buf, off, len(buf) - off)
+    (_m, _v, _k, flags, _len, action, oid, aid, sid, price, size,
+     nxt, prv) = _FRAME.unpack_from(buf, off)
+    tid = (_TID_WORD.unpack_from(buf, off + FRAME_SIZE)[0]
+           if flags & FLAG_TID else None)
+    return OrderMsg(action, oid, aid, sid, price, size,
+                    nxt if flags & FLAG_NEXT else None,
+                    prv if flags & FLAG_PREV else None), tid, off + flen
 
 
 def decode_frame(buf, off: int = 0) -> Tuple["OrderMsg", int]:
-    """Decode one frame at `off`; returns (msg, next_offset). The
-    Python authority for the frame format — the native acceptor
-    (kme_front.cpp) and the numpy batch path (parse_frames) are pinned
-    byte-exact against it by tests/test_wire_fuzz.py."""
-    _check_frame_header(buf, off, len(buf) - off)
-    (_m, _v, _k, flags, _len, action, oid, aid, sid, price, size,
-     nxt, prv) = _FRAME.unpack_from(buf, off)
-    return OrderMsg(action, oid, aid, sid, price, size,
-                    nxt if flags & 1 else None,
-                    prv if flags & 2 else None), off + FRAME_SIZE
+    """decode_frame_tid without the trace word (the pre-ISSUE-12
+    shape; existing callers keep their two-tuple)."""
+    m, _tid, nxt = decode_frame_tid(buf, off)
+    return m, nxt
 
 
 def decode_frames(buf) -> List["OrderMsg"]:
@@ -152,6 +189,16 @@ def decode_frames(buf) -> List["OrderMsg"]:
     while off < len(buf):
         m, off = decode_frame(buf, off)
         out.append(m)
+    return out
+
+
+def decode_frames_tid(buf) -> List[Tuple["OrderMsg", Optional[int]]]:
+    """Whole-buffer decode keeping the per-frame trace words."""
+    out: List[Tuple[OrderMsg, Optional[int]]] = []
+    off = 0
+    while off < len(buf):
+        m, tid, off = decode_frame_tid(buf, off)
+        out.append((m, tid))
     return out
 
 
@@ -365,23 +412,38 @@ class WireBatch:
 
     Columns (numpy): action/oid/aid/sid/price/size/next/prev int64,
     hnext/hprev uint8 (1 = pointer present — Jackson binds next/prev
-    from input too, see module docstring). Values beyond int64 cannot be
-    represented; builders raise OverflowError and callers stay on the
-    OrderMsg-list path (which carries arbitrary ints)."""
+    from input too, see module docstring), plus tid int64 / htid uint8
+    for the additive trace word (zeros when no frame carried one).
+    Values beyond int64 cannot be represented; builders raise
+    OverflowError and callers stay on the OrderMsg-list path (which
+    carries arbitrary ints)."""
 
     __slots__ = ("n", "action", "oid", "aid", "sid", "price", "size",
-                 "next", "prev", "hnext", "hprev", "_msgs")
+                 "next", "prev", "hnext", "hprev", "tid", "htid",
+                 "_msgs")
 
     _COLS = ("action", "oid", "aid", "sid", "price", "size", "next",
              "prev")
 
-    def __init__(self, n, cols, hnext, hprev, msgs=None):
+    def __init__(self, n, cols, hnext, hprev, msgs=None, tid=None,
+                 htid=None):
         self.n = n
         for f, v in zip(self._COLS, cols):
             setattr(self, f, v)
         self.hnext = hnext
         self.hprev = hprev
+        if tid is None or htid is None:
+            import numpy as np
+
+            tid = np.zeros(n, np.int64)
+            htid = np.zeros(n, np.uint8)
+        self.tid = tid
+        self.htid = htid
         self._msgs = msgs
+
+    def record_tid(self, i: int) -> Optional[int]:
+        """The trace word carried by row `i`, or None."""
+        return int(self.tid[i]) if self.htid[i] else None
 
     def __len__(self) -> int:
         return self.n
@@ -475,9 +537,11 @@ class WireBatch:
     @classmethod
     def _parse_frames_py(cls, buf: bytes) -> "WireBatch":
         """Pure-numpy frame decode: one frombuffer over the fixed
-        72-byte records, vectorized validation; ANY invalidity re-walks
-        the buffer through decode_frame so the raised error is exactly
-        the authority's (first bad frame, field-priority order)."""
+        72-byte records, vectorized validation; a traced (80-byte)
+        frame anywhere drops to the variable-stride authority walk,
+        and ANY invalidity re-walks the buffer through decode_frame so
+        the raised error is exactly the authority's (first bad frame,
+        field-priority order)."""
         import numpy as np
 
         nf, tail = divmod(len(buf), FRAME_SIZE)
@@ -488,14 +552,70 @@ class WireBatch:
         bad = ((hdr[:, 0] != WIRE_MAGIC) | (hdr[:, 1] != WIRE_VERSION)
                | (hdr[:, 2] != FRAME_ORDER)
                | (a["length"] != FRAME_SIZE))
-        if tail or bad.any():
-            decode_frames(buf)  # raises WireFrameError at first bad
-            raise AssertionError("frame walk accepted a bad buffer")
+        if tail or bad.any() or (hdr[:, 3] & FLAG_TID).any():
+            # traced frames shift every subsequent header, so the
+            # fixed-stride view above is meaningless the moment one
+            # appears. A uniformly-traced buffer (loadgen/bench stamp
+            # EVERY frame) re-views at the 80-byte stride and stays
+            # vectorized; only mixed/invalid buffers pay the walk,
+            # which is the single authority for the error surface
+            wb = cls._parse_frames_traced_py(buf)
+            if wb is not None:
+                return wb
+            return cls._parse_frames_walk(buf)
         v = a["v"]
         cols = [np.ascontiguousarray(v[:, i]) for i in range(8)]
         flags = hdr[:, 3]
         return cls(nf, cols, (flags & 1).astype(np.uint8),
                    ((flags >> 1) & 1).astype(np.uint8))
+
+    @classmethod
+    def _parse_frames_traced_py(cls, buf: bytes
+                                ) -> Optional["WireBatch"]:
+        """Vectorized decode for a buffer of UNIFORM 80-byte traced
+        frames (every header valid, every frame FLAG_TID): one
+        frombuffer at the wider stride, same checks as the untraced
+        fast path. Returns None — caller falls to the authority walk —
+        for anything mixed, torn, or invalid."""
+        import numpy as np
+
+        nf, tail = divmod(len(buf), FRAME_SIZE_TRACED)
+        if tail or nf == 0:
+            return None
+        dt = np.dtype([("hdr", "<u1", (4,)), ("length", "<u4"),
+                       ("v", "<i8", (8,)), ("tid", "<i8")])
+        a = np.frombuffer(buf, dt, count=nf)
+        hdr = a["hdr"]
+        bad = ((hdr[:, 0] != WIRE_MAGIC)
+               | (hdr[:, 1] != WIRE_VERSION)
+               | (hdr[:, 2] != FRAME_ORDER)
+               | (a["length"] != FRAME_SIZE_TRACED)
+               | ((hdr[:, 3] & FLAG_TID) == 0))
+        if bad.any():
+            return None
+        v = a["v"]
+        cols = [np.ascontiguousarray(v[:, i]) for i in range(8)]
+        flags = hdr[:, 3]
+        return cls(nf, cols, (flags & 1).astype(np.uint8),
+                   ((flags >> 1) & 1).astype(np.uint8),
+                   tid=np.ascontiguousarray(a["tid"]),
+                   htid=np.ones(nf, np.uint8))
+
+    @classmethod
+    def _parse_frames_walk(cls, buf: bytes) -> "WireBatch":
+        """Per-frame authority walk (decode_frame_tid): handles mixed
+        72/80-byte buffers and raises the authoritative WireFrameError
+        at the first bad frame."""
+        import numpy as np
+
+        pairs = decode_frames_tid(buf)
+        wb = cls.from_msgs([m for m, _t in pairs])
+        n = len(pairs)
+        wb.tid = np.fromiter((0 if t is None else t
+                              for _m, t in pairs), np.int64, n)
+        wb.htid = np.fromiter((t is not None for _m, t in pairs),
+                              np.uint8, n)
+        return wb
 
     def msgs(self) -> list:
         """Materialize the OrderMsg view (lazily, for oracle/judge
@@ -547,7 +667,9 @@ def _parse_frames_native(buf: bytes, emit: bool):
             lib.kme_parse_col(h, i), (n,)).copy() for i in range(8)]
         hnext = np.ctypeslib.as_array(lib.kme_parse_hnext(h), (n,)).copy()
         hprev = np.ctypeslib.as_array(lib.kme_parse_hprev(h), (n,)).copy()
-        wb = WireBatch(n, cols, hnext, hprev)
+        tid = np.ctypeslib.as_array(lib.kme_parse_tid(h), (n,)).copy()
+        htid = np.ctypeslib.as_array(lib.kme_parse_htid(h), (n,)).copy()
+        wb = WireBatch(n, cols, hnext, hprev, tid=tid, htid=htid)
         values = None
         if emit:
             nbytes = int(lib.kme_parse_emit(h))

@@ -1,0 +1,412 @@
+"""Cluster-wide distributed tracing (telemetry/dtrace.py, kme-trace
+--cluster, kme-agg). Pins the contracts the observability plane stands
+on:
+
+- trace identity is REPLAY-DERIVED: pure mixes of durable identity
+  (offset/aid/oid), never a clock or RNG — re-running the same input
+  re-mints byte-identical ids (and the vectorized batch minter matches
+  the scalar bit for bit);
+- the stitcher joins per-group span journals to the deterministic
+  front split offline: every admitted order gets exactly one complete
+  waterfall, cross-shard transfer legs linked parent/child, replay
+  segments deduplicated by the durable (group, local_off, kind) key;
+- tracing is ADDITIVE: MatchOut bytes are identical with span
+  journaling on or off, and the span ETYPE round-trips identically
+  through the JSONL and binary journal framings;
+- the SLO plane merges latency histograms at the raw bucket level —
+  cluster quantiles are exact, not quantile-of-quantiles — and its
+  p99 exemplars resolve back to stitched waterfalls.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from kme_tpu import opcodes as op
+from kme_tpu.bridge import front
+from kme_tpu.bridge.broker import InProcessBroker
+from kme_tpu.bridge.provision import group_topics, provision
+from kme_tpu.bridge.service import TOPIC_IN, MatchService
+from kme_tpu.telemetry import dtrace
+from kme_tpu.telemetry.journal import SPAN_KINDS, Journal, read_events
+from kme_tpu.wire import dumps_order
+from kme_tpu.workload import cross_account_stream, harness_stream
+
+
+# -- identity ----------------------------------------------------------
+
+
+def test_trace_ids_are_pure_and_distinct():
+    a = dtrace.trace_id(7, 42, 123456)
+    assert a == dtrace.trace_id(7, 42, 123456)      # pure
+    assert a != dtrace.trace_id(8, 42, 123456)      # offset matters
+    assert a != dtrace.local_tid(7, 42)             # distinct salt
+    assert a != dtrace.client_trace_id(7, 42, 123456)
+    assert a != dtrace.child_tid(a, 1)
+    assert dtrace.child_tid(a, 1) != dtrace.child_tid(a, 2)
+    for tid in (a, dtrace.local_tid(0, 0), dtrace.child_tid(a, 1),
+                dtrace.client_trace_id(0, 0, 0)):
+        assert 0 < tid < (1 << 63)      # journal <q packable, nonzero
+
+
+def test_vectorized_client_ids_match_scalar():
+    rng = random.Random(5)
+    seq0 = rng.randrange(0, 1 << 40)
+    aids = [rng.randrange(0, 1 << 31) for _ in range(64)]
+    oids = [rng.randrange(0, 1 << 62) for _ in range(64)]
+    assert dtrace.client_trace_ids(seq0, aids, oids) == [
+        dtrace.client_trace_id(seq0 + j, aids[j], oids[j])
+        for j in range(64)]
+
+
+# -- route map ---------------------------------------------------------
+
+
+def _grouped_lines(events=240, ngroups=2, seed=4, cross_frac=1.0):
+    msgs = cross_account_stream(events, 32 * ngroups, 8 * ngroups,
+                                ngroups, seed=seed,
+                                cross_frac=cross_frac)
+    return [dumps_order(m) for m in msgs]
+
+
+def test_route_map_matches_split_and_classifies_legs():
+    lines = _grouped_lines()
+    entries, router = dtrace.route_map(lines, 2)
+    per, ref_router = front.split_lines(lines, 2)
+    assert router.counters == ref_router.counters
+    # primary rows and legs back-reference the exact split positions
+    li = [0, 0]
+    for ent, line in zip(entries, lines):
+        assert ent is not None
+        rows = sorted([(ent["g"], ent["li"])]
+                      + [(lg["g"], lg["li"]) for lg in ent["legs"]])
+        for g, idx in rows:
+            li[g] = max(li[g], idx + 1)
+        assert per[ent["g"]][ent["li"]] == line
+    assert li == [len(per[0]), len(per[1])]
+    # cross-shard BUY/SELL legs come in route_line's emission order:
+    # home debit first (xfer_reserve), symbol credit second
+    crossed = [e for e in entries
+               if e["act"] in (op.BUY, op.SELL) and e["legs"]]
+    assert crossed, "cross_frac=1.0 produced no cross-shard orders"
+    for ent in crossed:
+        assert [lg["kind"] for lg in ent["legs"]] == [
+            "xfer_reserve", "xfer_settle"]
+        assert {lg["tid"] for lg in ent["legs"]} == {
+            dtrace.child_tid(ent["tid"], 1),
+            dtrace.child_tid(ent["tid"], 2)}
+    # CREATE_BALANCE broadcasts are "route" legs on the other groups
+    creates = [e for e in entries
+               if e["act"] == op.CREATE_BALANCE and e["legs"]]
+    assert creates
+    for ent in creates:
+        assert all(lg["kind"] == "route" for lg in ent["legs"])
+
+
+# -- in-process cluster run + stitching --------------------------------
+
+
+def _run_group(k, ngroups, glines, tmp_path, trace=True, batch=64):
+    """Serve one group's substream in-process; returns the journal
+    path and the group's MatchOut values."""
+    gdir = tmp_path / f"group{k}" / "state"
+    os.makedirs(gdir, exist_ok=True)
+    jp = str(gdir / "journal.bin")
+    br = InProcessBroker()
+    topics = group_topics(k) if ngroups > 1 else None
+    provision(br, topics=topics)
+    topic_in = topics[0] if topics else TOPIC_IN
+    for ln in glines:
+        br.produce(topic_in, None, ln)
+    svc = MatchService(br, engine="oracle", compat="fixed",
+                       batch=batch, journal=jp, trace_spans=trace,
+                       group=(k, ngroups) if ngroups > 1 else None)
+    seen = 0
+    while seen < len(glines):
+        seen += svc.step(timeout=0.1)
+    svc.close()
+    out_topic = topics[1] if topics else "MatchOut"
+    out = [r.value for r in br.fetch(out_topic, 0, 1 << 20)]
+    snap = svc.telemetry.snapshot()
+    return jp, out, snap
+
+
+def _stitch_run(lines, ngroups, tmp_path):
+    per, _router = front.split_lines(lines, ngroups)
+    group_events, snaps = {}, []
+    for k in range(ngroups):
+        jp, _out, snap = _run_group(k, ngroups, per[k], tmp_path)
+        group_events[k] = [ev for ev in read_events(jp)
+                           if ev.get("e") in ("span", "lat")]
+        snaps.append((f"g{k}", snap))
+    return dtrace.stitch(lines, group_events, ngroups), snaps
+
+
+@pytest.mark.parametrize("ngroups", [2, 4])
+def test_stitch_links_every_admitted_order(ngroups, tmp_path):
+    lines = _grouped_lines(events=200, ngroups=ngroups, seed=7)
+    doc, _snaps = _stitch_run(lines, ngroups, tmp_path)
+    assert doc["admitted"] == len(lines)
+    assert doc["stitched"] == doc["admitted"]       # 100% >= 99.9%
+    by_off = {o["off"]: o for o in doc["orders"]}
+    assert len(by_off) == len(doc["orders"])        # no forks
+    entries, _ = dtrace.route_map(lines, ngroups)
+    for ent in entries:
+        o = by_off[ent["off"]]
+        assert o["complete"], o
+        kinds = [sp["kind"] for sp in o["spans"]]
+        for stage in ("front_accept", "route", "ingress", "plan",
+                      "device", "produce", "merge"):
+            assert stage in kinds, (o["off"], kinds)
+        # every injected leg resolved on ITS group, linked to parent
+        legs = [sp for sp in o["spans"]
+                if sp["kind"] in ("xfer_reserve", "xfer_settle")]
+        want = [lg for lg in ent["legs"]
+                if lg["kind"] != "route"]
+        assert len(legs) == len(want)
+        for sp, lg in zip(legs, want):
+            assert sp["g"] == lg["g"]
+            assert sp["tid"] == lg["tid"]
+            assert sp["ptid"] == ent["tid"]
+        # waterfall extent covers every span (legs run on the other
+        # group's clock and must not fall outside the window)
+        for sp in o["spans"]:
+            assert o["t0"] <= sp["t0"] <= sp["t1"] <= o["t1"]
+
+
+def test_crash_replay_restitches_identical_ids(tmp_path):
+    """Two independent runs over the same substreams (the crash-replay
+    model: same input prefix, fresh wall clocks) stitch to the same
+    trace ids, spans and linkage — only timestamps differ."""
+    lines = _grouped_lines(events=120, ngroups=2, seed=11)
+
+    def skeleton(doc):
+        return [(o["off"], o["tid"], o["complete"],
+                 [(sp["kind"], sp["g"], sp["tid"], sp["ptid"])
+                  for sp in o["spans"]])
+                for o in doc["orders"]]
+
+    doc1, _ = _stitch_run(lines, 2, tmp_path / "run1")
+    doc2, _ = _stitch_run(lines, 2, tmp_path / "run2")
+    assert skeleton(doc1) == skeleton(doc2)
+
+
+def test_replay_overlap_dedups_first_wins():
+    evs = [{"e": "span", "kind": "ingress", "off": 0, "oid": 1,
+            "tid": 9, "ptid": 0, "t0": 100, "t1": 110},
+           {"e": "span", "kind": "ingress", "off": 0, "oid": 1,
+            "tid": 9, "ptid": 0, "t0": 900, "t1": 910}]
+    spans = dtrace.collect_group_spans(evs, 0)
+    assert spans[(0, "ingress")]["t0"] == 100      # first occurrence
+
+
+def test_matchout_bytes_identical_tracing_on_off(tmp_path):
+    lines = [dumps_order(m) for m in harness_stream(
+        200, seed=3, num_accounts=6, num_symbols=2,
+        payout_opcode_bug=False, validate=True)]
+    _jp1, out_on, _ = _run_group(0, 1, lines, tmp_path / "on",
+                                 trace=True)
+    _jp2, out_off, _ = _run_group(0, 1, lines, tmp_path / "off",
+                                  trace=False)
+    assert out_on == out_off
+
+
+def test_span_events_roundtrip_json_and_binary(tmp_path):
+    spans = [{"kind": k, "g": 1, "off": 10 + i, "oid": 5 + i,
+              "aid": 3, "tid": dtrace.local_tid(1, 10 + i),
+              "ptid": 0, "t0": 1000 + i, "t1": 1010 + i, "li": -1}
+             for i, k in enumerate(SPAN_KINDS)]
+    docs = {}
+    for ext in ("jsonl", "bin"):
+        p = str(tmp_path / f"j.{ext}")
+        j = Journal(p, resume=False)
+        j.record_spans(spans, batch=2)
+        j.close()
+        docs[ext] = [ev for ev in read_events(p)
+                     if ev.get("e") == "span"]
+    assert len(docs["jsonl"]) == len(SPAN_KINDS)
+    for a, b in zip(docs["jsonl"], docs["bin"]):
+        for key in ("kind", "off", "oid", "tid", "ptid", "t0", "t1"):
+            assert a.get(key) == b.get(key), key
+
+
+# -- lat fallback, waterfall + chrome rendering ------------------------
+
+
+def test_lat_fallback_synthesizes_contiguous_stages():
+    ev = {"e": "lat", "off": 4, "oid": 9, "ts": 5000, "e2e_us": 40,
+          "in_us": 10, "plan_us": 5, "dev_us": 20, "prod_us": 5}
+    spans = dtrace.collect_group_spans([ev], 2)
+    t = 5000 - 40
+    for kind, dur in (("ingress", 10), ("plan", 5), ("device", 20),
+                      ("produce", 5)):
+        sp = spans[(4, kind)]
+        assert (sp["t0"], sp["t1"]) == (t, t + dur)
+        assert sp["tid"] == dtrace.local_tid(2, 4)
+        t += dur
+
+
+def test_waterfall_and_chrome_outputs(tmp_path):
+    lines = _grouped_lines(events=80, ngroups=2, seed=13)
+    doc, _ = _stitch_run(lines, 2, tmp_path)
+    order = doc["orders"][0]
+    text = dtrace.waterfall_text(order)
+    assert f"oid={order['oid']}" in text
+    assert f"tid=0x{order['tid']:016x}" in text
+    for sp in order["spans"]:
+        assert sp["kind"] in text
+    chrome = dtrace.chrome_trace_doc(doc)
+    evs = chrome["traceEvents"]
+    assert {e["ph"] for e in evs} >= {"M", "X"}
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == sum(len(o["spans"]) for o in doc["orders"])
+    # cross-group hops draw flow arrows
+    assert any(e["ph"] == "s" for e in evs)
+    assert any(e["ph"] == "f" and e.get("bp") == "e" for e in evs)
+    json.dumps(chrome)      # serializable as written
+
+
+def test_find_order_by_aid_oid_and_tid(tmp_path):
+    lines = _grouped_lines(events=60, ngroups=2, seed=17)
+    doc, _ = _stitch_run(lines, 2, tmp_path)
+    from collections import Counter
+
+    keys = Counter((o["aid"], o["oid"]) for o in doc["orders"])
+    o = next(o for o in doc["orders"]
+             if keys[(o["aid"], o["oid"])] == 1)
+    assert dtrace.find_order(doc, f"{o['aid']}:{o['oid']}") is o
+    assert dtrace.find_order(doc, str(o["tid"])) is o
+    assert dtrace.find_order(doc, hex(o["tid"])) is o
+    assert dtrace.find_order(doc, "999999:1") is None
+
+
+# -- front trace + state-root stitching --------------------------------
+
+
+def test_write_front_trace_spans_are_real_at_stitch(tmp_path):
+    lines = _grouped_lines(events=60, ngroups=2, seed=19)
+    tp = str(tmp_path / "front.trace")
+    wrote = front.write_front_trace(tp, lines, 2)
+    assert wrote == 2 * len(lines)      # front_accept + route each
+    per, _ = front.split_lines(lines, 2)
+    group_events = {}
+    for k in range(2):
+        jp, _out, _snap = _run_group(k, 2, per[k], tmp_path)
+        group_events[k] = [ev for ev in read_events(jp)
+                           if ev.get("e") in ("span", "lat")]
+    doc = dtrace.stitch(lines, group_events, 2,
+                        front_events=list(read_events(tp)))
+    for o in doc["orders"]:
+        for sp in o["spans"]:
+            if sp["kind"] in ("front_accept", "route"):
+                assert not sp.get("synthetic"), sp
+
+
+def test_stitch_state_root_layout(tmp_path):
+    lines = _grouped_lines(events=60, ngroups=2, seed=23)
+    per, _ = front.split_lines(lines, 2)
+    for k in range(2):
+        _run_group(k, 2, per[k], tmp_path)
+    with open(tmp_path / "front.in", "w") as f:
+        f.write("\n".join(lines) + "\n")
+    doc = dtrace.stitch_state_root(str(tmp_path))
+    assert doc["admitted"] == doc["stitched"] == len(lines)
+    assert dtrace.discover_groups(str(tmp_path)) == [
+        (0, str(tmp_path / "group0")), (1, str(tmp_path / "group1"))]
+    with pytest.raises(FileNotFoundError):
+        dtrace.stitch_state_root(str(tmp_path / "group0"))
+
+
+# -- exemplars + the SLO plane -----------------------------------------
+
+
+def test_exemplars_resolve_to_waterfalls(tmp_path):
+    lines = _grouped_lines(events=120, ngroups=2, seed=29)
+    doc, snaps = _stitch_run(lines, 2, tmp_path)
+    agg = dtrace.aggregate(snaps, slo_ms=60_000.0)
+    assert agg["exemplars"], "service kept no slowest-order exemplars"
+    # worst first, and each resolves to a stitched waterfall
+    e2es = [e["e2e_us"] for e in agg["exemplars"]]
+    assert e2es == sorted(e2es, reverse=True)
+    for ex in agg["exemplars"][:4]:
+        o = dtrace.find_order(doc, f"{ex['aid']}:{ex['oid']}")
+        assert o is not None and o["complete"]
+        # the exemplar's group-local join key resolves on its own,
+        # to the exact order (kme-trace --order 0x<tid>)
+        o2 = dtrace.find_order(doc, f"0x{ex['tid']:x}")
+        assert o2 is not None and ex["tid"] in o2["ltids"]
+    # SLO plane: merged e2e count covers every record the groups
+    # served exactly once (input lines + front-injected XFER legs)
+    per, _ = front.split_lines(lines, 2)
+    assert agg["e2e"]["count"] == sum(len(p) for p in per)
+    assert agg["slo"]["burn_rate"] is not None
+    text = dtrace.render_agg(agg)
+    assert "slowest orders" in text
+
+
+def test_merged_quantiles_are_exact():
+    """Summing buckets then computing quantiles == one histogram that
+    saw every observation (never quantile-of-quantiles)."""
+    from kme_tpu.telemetry.registry import LatencyHistogram
+
+    def snap_of(h):
+        count, total, counts = h.state()
+        return {"count": count, "sum_s": round(total, 6),
+                "p50_ms": round(h._quantile_from(
+                    counts, count, 0.5) * 1e3, 3),
+                "p90_ms": round(h._quantile_from(
+                    counts, count, 0.9) * 1e3, 3),
+                "p99_ms": round(h._quantile_from(
+                    counts, count, 0.99) * 1e3, 3),
+                "p999_ms": round(h._quantile_from(
+                    counts, count, 0.999) * 1e3, 3),
+                "buckets": counts}
+
+    rng = random.Random(31)
+    h1, h2, href = (LatencyHistogram("lat_e2e") for _ in range(3))
+    for i in range(400):
+        v = rng.uniform(1e-6, 0.5)
+        (h1 if i % 2 else h2).observe(v)
+        href.observe(v)
+    snaps = [("a", {"latencies": {"lat_e2e": snap_of(h1)}}),
+             ("b", {"latencies": {"lat_e2e": snap_of(h2)}})]
+    merged = dtrace.merge_latencies(snaps)["lat_e2e"]
+    want = snap_of(href)
+    assert merged["buckets"] == want["buckets"]
+    for q in ("p50_ms", "p90_ms", "p99_ms", "p999_ms"):
+        assert merged[q] == want[q], q
+
+
+def test_aggregate_renders_degraded_rows():
+    snaps = [("g0", {"latencies": {}, "gauges": {}, "counters": {}}),
+             ("g1", None)]
+    agg = dtrace.aggregate(snaps)
+    rows = {r["source"]: r for r in agg["per_group"]}
+    assert rows["g0"]["up"] and not rows["g1"]["up"]
+    assert "DEGRADED (unreachable)" in dtrace.render_agg(agg)
+
+
+# -- endpoint discovery (kme-top --cluster) ----------------------------
+
+
+def test_discover_endpoints_and_cluster_render(tmp_path):
+    from kme_tpu.telemetry import top
+
+    for k in range(2):
+        os.makedirs(tmp_path / f"group{k}" / "state")
+    hb = {"pid": 1, "time": 0, "offset": 7,
+          "metrics": {"counters": {"service_records": 7},
+                      "gauges": {}, "latencies": {}}}
+    with open(tmp_path / "group0" / "state" / "serve.health",
+              "w") as f:
+        json.dump(hb, f)
+    eps = top.discover_endpoints(str(tmp_path))
+    assert [g["k"] for g in eps["groups"]] == [0, 1]
+    cur = top.collect_cluster(eps["groups"])
+    text = "\n".join(top.render_cluster(cur))
+    assert "g0" in text
+    # group1 never wrote a heartbeat: a degraded row, not a crash
+    assert "DEGRADED" in text
+    assert "1/2 groups up" in text

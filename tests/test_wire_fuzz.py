@@ -339,3 +339,66 @@ def test_tcp_rows_roundtrip_3_5_6_elements():
         assert len(_row(got[0])) == 6 and len(_row(got[1])) == 6
     finally:
         srv.shutdown()
+
+
+def test_traced_frame_fuzz_roundtrip_and_rejects():
+    """Trace-word carriage (80-byte FLAG_TID frames) fuzzed through
+    both parse entry points: uniform-traced buffers (the vectorized
+    fast path), mixed 72/80-byte buffers (the walking authority), and
+    untraced controls must all hand back identical (msg, tid) pairs —
+    and seeded corruption must reject with identical reason AND
+    message text from both, including the traced-specific length
+    confusion (a 72-byte length prefix on a frame whose flags claim
+    FLAG_TID, and vice versa)."""
+    from kme_tpu.wire import (FRAME_SIZE, FRAME_SIZE_TRACED, WireBatch,
+                              WireFrameError, decode_frames_tid,
+                              encode_frames)
+
+    rng = random.Random(0x71D)
+    for trial in range(120):
+        n = rng.randrange(1, 16)
+        msgs = [_random_frame_msg(rng) for _ in range(n)]
+        style = trial % 3
+        if style == 0:      # uniform traced: vectorized decode
+            tids = [rng.randrange(1, 1 << 63) for _ in range(n)]
+        elif style == 1:    # mixed: must fall to the walking decoder
+            tids = [rng.randrange(1, 1 << 63) if rng.random() < 0.5
+                    else None for _ in range(n)]
+        else:               # untraced control
+            tids = [None] * n
+        buf = encode_frames(msgs, tids=tids)
+        assert decode_frames_tid(buf) == list(zip(msgs, tids))
+        wb = WireBatch.parse_frames(buf)
+        assert wb.n == n
+        for i in range(n):
+            assert wb.record_tid(i) == tids[i], f"trial {trial} row {i}"
+        # seeded corruption: walk the mixed-length layout so the
+        # mangled byte lands inside a chosen real frame
+        offs, lens, off = [], [], 0
+        for t in tids:
+            offs.append(off)
+            ln = FRAME_SIZE_TRACED if t is not None else FRAME_SIZE
+            lens.append(ln)
+            off += ln
+        j = rng.randrange(n)
+        b = bytearray(buf)
+        kind = rng.randrange(3)
+        if kind == 0:       # truncate inside frame j
+            bad = bytes(b[:offs[j] + rng.randrange(1, lens[j])])
+            reason = "truncated"
+        elif kind == 1:     # trashed magic
+            b[offs[j]] = rng.choice([0, ord("{"), 0xB0, 0xFF])
+            bad, reason = bytes(b), "bad_magic"
+        else:               # length prefix contradicts the FLAG_TID bit
+            wrong = (FRAME_SIZE if tids[j] is not None
+                     else FRAME_SIZE_TRACED)
+            b[offs[j] + 4:offs[j] + 8] = wrong.to_bytes(4, "little")
+            bad, reason = bytes(b), "bad_length"
+        with pytest.raises(WireFrameError) as e1:
+            decode_frames_tid(bad)
+        with pytest.raises(WireFrameError) as e2:
+            WireBatch.parse_frames(bad)
+        assert e1.value.reason == e2.value.reason == reason, (
+            f"trial {trial}: want {reason}, got "
+            f"{e1.value.reason}/{e2.value.reason}")
+        assert str(e1.value) == str(e2.value), f"trial {trial}"
