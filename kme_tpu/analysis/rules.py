@@ -123,6 +123,39 @@ TRACE_SCOPES: Dict[str, Set[str]] = {
         "_spans_from_lat", "stitch"},
 }
 
+# Feed scopes (KME-D00x, same determinism rules): the market-data
+# read path's REPLAY-PURITY surface (ISSUE 13). Book-delta derivation
+# must be a pure function of the MatchOut stream — any two derivers at
+# the same (group, out_seq) watermark must emit byte-identical frames,
+# which is the entire failover story for the feed tier (a promoted
+# deriver regenerates the dead one's frames exactly). A wall clock or
+# RNG anywhere in the derivation, the frame codec, or the snapshot
+# save/restore forks the frame stream silently. Merged into replay_fns
+# per file by _RuleVisitor, like TRACE_SCOPES.
+FEED_SCOPES: Dict[str, Set[str]] = {
+    "kme_tpu/feed/frames.py": {
+        "_envelope", "encode_delta", "encode_tob", "encode_depth",
+        "encode_snap_begin", "encode_snap_end", "encode_resync",
+        "_check_feed_header", "decode_feed", "decode_feed_frames",
+        "feed_frame_length"},
+    "kme_tpu/feed/derive.py": {
+        # BookState + canonical comparators
+        "set_level", "get_level", "tob", "depth", "sids",
+        "canonical_books", "books_from_oracle",
+        # FeedDeriver: emission + mutation + snapshot state
+        "_next_seq", "_frame", "_emit_delta", "_emit_tob",
+        "_emit_depth", "_level_add", "_drop_resting", "_apply_out",
+        "on_record", "on_line", "state", "from_state",
+        # BookBuilder: the subscriber-side replay of the frame stream
+        "_seq_ok", "_apply_image", "apply", "apply_buffer"},
+    # the durable snapshot payload and the wire handover must restore /
+    # serve bit-identically (file naming is offset-based, never
+    # clock-based; frame seqs come from the deriver, never minted here)
+    "kme_tpu/feed/snapshot.py": {
+        "feed_snapshot_path", "_state_digest", "_load_one",
+        "snapshot_frames"},
+}
+
 # Tracer scopes: whole directories — everything under them runs (or is
 # staged to run) under jit/vmap/scan/pallas_call.
 TRACED_DIRS = ("kme_tpu/engine/", "kme_tpu/ops/")
@@ -164,7 +197,8 @@ class _RuleVisitor(ast.NodeVisitor):
         self._scope: List[str] = []
         self.hot_fns = HOT_SCOPES.get(relpath, set())
         self.replay_fns = (REPLAY_SCOPES.get(relpath, set())
-                           | TRACE_SCOPES.get(relpath, set()))
+                           | TRACE_SCOPES.get(relpath, set())
+                           | FEED_SCOPES.get(relpath, set()))
         self.traced = relpath.startswith(TRACED_DIRS)
 
     # -- bookkeeping ----------------------------------------------------

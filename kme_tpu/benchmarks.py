@@ -1620,6 +1620,303 @@ def bench_wire(events: int = 20_000, seed: int = 0,
     }
 
 
+def bench_feed(events: int = 20_000, seed: int = 0,
+               subs: int = 10_000, symbols: int = 1_000,
+               profile: str = "flash-crowd",
+               queue_bytes: int = 64 * 1024,
+               depth_every: int = 256, depth_levels: int = 8) -> dict:
+    """Market-data fan-out suite (`--suite feed`): a storm write
+    profile replays through the Python oracle into an in-process
+    broker, one FeedServer derives sequenced book frames from the
+    MatchOut stream, and `subs` TCP subscribers (each pinned to one
+    symbol, plus two wildcard auditors that take the whole feed)
+    reconstruct their books from the wire bytes.
+
+    Correctness is structural, not statistical:
+
+      * the deriver is run TWICE from scratch over the same stream and
+        must emit byte-identical concatenated frames (determinism —
+        the failover guarantee);
+      * every subscriber's reconstructed book must be byte-exact
+        (`canonical_books`) against the oracle's resting-order store
+        restricted to its subscription — including subscribers that
+        went through conflation/resync cycles;
+      * the wildcard auditors are additionally checked level-by-level
+        at every depth (top-1, top-`depth_levels`, full) and on their
+        top-of-book view;
+      * per-symbol sequence accounting must show zero gaps and zero
+        duplicates on every subscriber.
+
+    `feed_msgs_per_sec` (frames delivered to subscriber sockets per
+    second of fan-out wall, up-is-better) and `feed_lag_p99_ms`
+    (admission-stamp -> frame-derivation p99, down-is-better) are
+    perfgate-gated vs BASELINE_feed.json on CPU."""
+    import resource
+    import selectors
+    import socket
+    import tempfile
+
+    from kme_tpu import opcodes as op
+    from kme_tpu.bridge.broker import InProcessBroker
+    from kme_tpu.feed.client import subscribe_line
+    from kme_tpu.feed.derive import (BookBuilder, BookState, FeedDeriver,
+                                     books_from_oracle, canonical_books)
+    from kme_tpu.feed.server import FeedServer
+    from kme_tpu.oracle import OracleEngine
+    from kme_tpu.telemetry import Registry
+    from kme_tpu.workload import storm_stream
+
+    # fd headroom: every subscriber is TWO sockets (client + accepted
+    # server end). Never silently shrink the fleet — print what was
+    # dropped when the rlimit wins.
+    try:
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        want = 2 * subs + 512
+        if soft < want:
+            lift = want if hard == resource.RLIM_INFINITY \
+                else min(want, hard)
+            resource.setrlimit(resource.RLIMIT_NOFILE, (lift, hard))
+            soft = lift
+        cap = max(16, (soft - 256) // 2)
+        if subs > cap:
+            print(f"kme-bench feed: RLIMIT_NOFILE={soft} caps "
+                  f"subscribers at {cap} (asked {subs})",
+                  file=sys.stderr)
+            subs = cap
+    except (ValueError, OSError):
+        pass
+
+    msgs = storm_stream(profile, events, num_symbols=symbols, seed=seed)
+    eng = OracleEngine("fixed")
+    lines = []
+    for m in msgs:
+        lines.extend(r.wire() for r in eng.process(m))
+    oracle_levels = books_from_oracle(eng)
+    oracle_state = BookState()
+    oracle_state.levels = oracle_levels
+    all_sids = sorted({m.sid for m in msgs
+                       if m.action == op.ADD_SYMBOL}) or [1]
+
+    # determinism: two fresh derivers over the same stream must emit
+    # byte-identical frames — this IS the failover guarantee
+    streams = []
+    for _ in range(2):
+        d = FeedDeriver(depth_every=depth_every,
+                        depth_levels=depth_levels)
+        streams.append(b"".join(
+            f.raw for i, ln in enumerate(lines)
+            for f in d.on_line(ln, 1, i)))
+    assert streams[0] == streams[1], (
+        "feed derivation is nondeterministic: two derivers over the "
+        "same MatchOut stream emitted different bytes")
+    ref_deriver = d
+
+    t_all = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        broker = InProcessBroker(persist_dir=td)
+        topic = "MatchOut"
+        broker.create_topic(topic)
+        registry = Registry()
+        srv = FeedServer(broker, port=0, topic=topic,
+                         depth_every=depth_every,
+                         depth_levels=depth_levels,
+                         queue_bytes=queue_bytes, registry=registry)
+        host, port = srv.address
+
+        # subscriber fleet: mostly 1-symbol subs spread round-robin,
+        # plus two wildcard auditors holding the full feed
+        plan = [None, None] + [
+            {all_sids[i % len(all_sids)]} for i in range(max(0, subs - 2))]
+        plan = plan[:max(2, subs)]
+        csel = selectors.DefaultSelector()
+        clients = []
+
+        class _C:
+            __slots__ = ("sock", "symbols", "out", "buf", "live", "eof")
+
+            def __init__(self, symbols) -> None:
+                self.symbols = symbols
+                self.out = subscribe_line(symbols)
+                self.buf = []
+                self.live = False
+                self.eof = False
+                self.sock = socket.socket(socket.AF_INET,
+                                          socket.SOCK_STREAM)
+                self.sock.setblocking(False)
+                self.sock.connect_ex((host, port))
+
+        def pump_clients(timeout: float) -> int:
+            moved = 0
+            for key, mask in csel.select(timeout=timeout):
+                c = key.data
+                if not c.live:
+                    if mask & selectors.EVENT_WRITE:
+                        try:
+                            n = c.sock.send(c.out)
+                        except (BlockingIOError, InterruptedError):
+                            continue
+                        c.out = c.out[n:]
+                        if not c.out:
+                            c.live = True
+                            csel.modify(c.sock, selectors.EVENT_READ, c)
+                    continue
+                try:
+                    data = c.sock.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    data = b""
+                if not data:
+                    c.eof = True
+                    csel.unregister(c.sock)
+                    continue
+                c.buf.append(data)
+                moved += len(data)
+            return moved
+
+        try:
+            # connect in waves so the listen backlog never overflows,
+            # stepping the server so it accepts + handshakes as we go
+            for lo in range(0, len(plan), 512):
+                for want in plan[lo:lo + 512]:
+                    c = _C(want)
+                    clients.append(c)
+                    csel.register(c.sock, selectors.EVENT_WRITE, c)
+                for _ in range(200):
+                    srv.step(0.001)
+                    pump_clients(0.0)
+                    if all(c.live for c in clients):
+                        break
+            deadline = time.monotonic() + 60
+            while (len(srv._subs) < len(clients)
+                   and time.monotonic() < deadline):
+                srv.step(0.001)
+                pump_clients(0.0)
+            assert len(srv._subs) == len(clients), (
+                f"only {len(srv._subs)}/{len(clients)} subscribers "
+                f"live after the connect phase")
+
+            # timed fan-out phase: produce the stamped MatchOut stream,
+            # then run the (single-threaded) server + client pumps
+            # until everything derived is on the wire
+            t0 = time.perf_counter()
+            for i, ln in enumerate(lines):
+                broker.produce(topic, None, ln, epoch=1, out_seq=i,
+                               ats=time.time_ns() // 1000)
+            end = len(lines)
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline:
+                n = srv.step(0.0)
+                pump_clients(0.0)
+                if (n == 0 and srv.offset >= end
+                        and not any(s.queue or s.conflating
+                                    for s in srv._subs.values())):
+                    break
+            elapsed = time.perf_counter() - t0
+            assert srv.offset >= end, (
+                f"feed server stalled at offset {srv.offset}/{end}")
+            stats = srv.stats()
+            lag = registry.latency("feed_lag").quantiles()
+        finally:
+            srv.close()   # EOF to every subscriber
+        # drain the client side to EOF: TCP buffers may still hold
+        # frames the server already counted as delivered
+        deadline = time.monotonic() + 60
+        while (any(not c.eof for c in clients)
+               and time.monotonic() < deadline):
+            if pump_clients(0.05) == 0 and all(
+                    not c.live or c.eof for c in clients):
+                break
+        csel.close()
+        for c in clients:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+
+    # reconstruction: every subscriber's book must be byte-exact vs
+    # the oracle store restricted to its subscription
+    conflated_subs = 0
+    total_frames_rx = 0
+    for ci, c in enumerate(clients):
+        blob = b"".join(c.buf)
+        bb = BookBuilder()
+        used = bb.apply_buffer(blob)
+        assert used == len(blob), (
+            f"sub {ci}: {len(blob) - used} trailing bytes did not "
+            f"decode as frames")
+        assert not bb.errors, f"sub {ci}: {bb.errors}"
+        assert not bb.gaps, f"sub {ci}: sequence gaps {bb.gaps[:4]}"
+        assert bb.dups == 0, f"sub {ci}: {bb.dups} duplicate seqs"
+        total_frames_rx += bb.frames
+        if bb.resyncs:
+            conflated_subs += 1
+        if c.symbols is None:
+            want_levels = oracle_levels
+        else:
+            want_levels = {k: v for k, v in oracle_levels.items()
+                           if k[0] in c.symbols}
+        assert canonical_books(bb.book) == canonical_books(
+            want_levels), (
+            f"sub {ci} (symbols={c.symbols}): reconstructed book "
+            f"diverged from the oracle store")
+        if c.symbols is None:
+            # auditors: level-by-level at every depth + the TOB view
+            for sid in sorted({s for s, _ in oracle_levels}):
+                for nd in (1, depth_levels, 0):
+                    assert bb.book.depth(sid, nd) == \
+                        oracle_state.depth(sid, nd), (
+                            f"auditor {ci}: depth-{nd} mismatch on "
+                            f"symbol {sid}")
+                assert bb.tob.get(sid) == oracle_state.tob(sid), (
+                    f"auditor {ci}: TOB mismatch on symbol {sid}")
+    delivered = stats["delivered"]
+    fan_mps = delivered / elapsed if elapsed > 0 else 0.0
+    lag_p99_ms = lag[0.99] * 1e3
+    import jax
+
+    backend = jax.default_backend()
+    total_s = time.perf_counter() - t_all
+    detail = {
+        "suite": "feed", "events": events, "records": len(lines),
+        "seed": seed, "profile": profile,
+        "subscribers": len(clients), "symbols": len(all_sids),
+        "queue_bytes": queue_bytes, "depth_every": depth_every,
+        "depth_levels": depth_levels,
+        "backend": backend,
+        "elapsed_s": round(total_s, 3),
+        "fanout_s": round(elapsed, 4),
+        "frames_derived": stats["frames"],
+        "frames_delivered": delivered,
+        "frames_received": total_frames_rx,
+        "deriver_frames": ref_deriver.frames_out,
+        "conflations": stats["conflations"],
+        "resyncs": stats["resyncs"],
+        "conflated_subs": conflated_subs,
+        "feed_lag_p50_ms": round(lag[0.5] * 1e3, 3),
+        # gated metrics (perfgate reads the detail root)
+        "feed_msgs_per_sec": round(fan_mps, 1),
+        "feed_lag_p99_ms": round(lag_p99_ms, 3),
+    }
+    print(f"kme-bench feed: {len(clients)} subs x {len(all_sids)} "
+          f"symbols [{profile}]: {fan_mps:,.0f} frames/s delivered "
+          f"({stats['frames']} derived, {stats['conflations']} "
+          f"conflations, {stats['resyncs']} resyncs) "
+          f"lag p50={detail['feed_lag_p50_ms']}ms "
+          f"p99={detail['feed_lag_p99_ms']}ms ({total_s:.1f}s)",
+          file=sys.stderr)
+    print(f"kme-bench feed: all {len(clients)} books byte-exact vs "
+          f"oracle (2 auditors at every depth), 0 gaps, 0 dups",
+          file=sys.stderr)
+    return {
+        "metric": "feed_msgs_per_sec",
+        "value": round(fan_mps, 1),
+        "unit": "frames/sec",
+        "vs_baseline": round(fan_mps / REFERENCE_BASELINE_OPS, 3),
+        "detail": detail,
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1627,8 +1924,12 @@ def main(argv=None) -> int:
     p.add_argument("--suite", choices=("lanes", "parity", "native",
                                        "latency", "pipeline",
                                        "shards", "groups", "storms",
-                                       "wire"),
+                                       "wire", "feed"),
                    default="lanes")
+    p.add_argument("--subs", type=int, default=10_000,
+                   help="feed suite: subscriber count (two of them "
+                        "are wildcard auditors; the rest pin one "
+                        "symbol each)")
     p.add_argument("--pipeline", type=int, default=2, metavar="N",
                    help="pipeline suite: in-flight batch window depth "
                         "(how many submits may run ahead of collect)")
@@ -1791,6 +2092,12 @@ def main(argv=None) -> int:
     elif args.suite == "wire":
         rec = bench_wire(args.events or 20_000, seed=args.seed,
                          batch=max(args.batch, 1))
+    elif args.suite == "feed":
+        rec = bench_feed(args.events or 20_000, seed=args.seed,
+                         subs=args.subs, symbols=args.symbols,
+                         profile=(args.workload
+                                  if args.workload in STORM_WORKLOADS
+                                  else "flash-crowd"))
     elif args.suite == "latency":
         rec = bench_latency(args.events or 20_000, args.symbols,
                             args.accounts, args.seed, args.zipf,

@@ -729,9 +729,269 @@ def run_shard_failover(args, run_dir: str, report_path: str) -> int:
     return 0 if report["ok"] else 1
 
 
+def run_feed_failover(args, run_dir: str, report_path: str) -> int:
+    """--scenario feed-failover: the market-data read path under the
+    write path's failover (ISSUE 13). A supervised kme-serve runs with
+    a hot standby and eats ONE seeded SIGKILL mid-stream while a real
+    kme-feed fan-out tier (FeedServer over a TcpBroker, reconnect
+    armed) serves LIVE subscribers — one wildcard auditor plus filtered
+    single/multi-symbol subs. Passes iff:
+
+    - the standby promoted (and within --max-failover seconds);
+    - the feed tier actually rode through the outage: at least one
+      broker reconnect fired, and the feed consumed the full durable
+      MatchOut log;
+    - every subscriber's reconstructed book is BYTE-EXACT
+      (canonical_books) against an in-process oracle replay of the
+      input, restricted to its subscription — the deriver on the
+      promoted leader's replayed tail regenerated the exact frames the
+      dead one would have sent;
+    - ZERO missing and ZERO duplicate per-symbol delta seqs on every
+      subscriber (BookBuilder gap/dup accounting), across the kill,
+      the reconnect and any conflation/resync cycles.
+    """
+    from kme_tpu.bridge.tcp import TcpBroker
+    from kme_tpu.feed.client import FeedClient
+    from kme_tpu.feed.derive import books_from_oracle, canonical_books
+    from kme_tpu.feed.server import FeedServer
+    from kme_tpu.oracle import OracleEngine
+    from kme_tpu.telemetry import Registry
+    from kme_tpu.wire import dumps_order, parse_order
+    from kme_tpu.workload import harness_stream
+
+    ckpt_dir = os.path.join(run_dir, "state")
+    state_dir = os.path.join(run_dir, "fault-state")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    schedule = args.schedule or failover_schedule(args.seed, args.events)
+    print(f"kme-chaos: scenario=feed-failover seed={args.seed} "
+          f"events={args.events}\nkme-chaos: schedule {schedule}\n"
+          f"kme-chaos: run dir {run_dir}", file=sys.stderr)
+
+    # ground truth: oracle replay of the input under the same envelope
+    # the serve runs with; the final resting-order store is what every
+    # subscriber book must reduce to
+    msgs = harness_stream(args.events, seed=args.seed,
+                          num_accounts=args.accounts,
+                          num_symbols=max(args.symbols, 6),
+                          payout_opcode_bug=False, validate=True)
+    lines = [dumps_order(m) for m in msgs]
+    eng = OracleEngine("fixed", book_slots=args.slots,
+                       max_fills=args.max_fills)
+    for ln in lines:
+        eng.process(parse_order(ln))
+    oracle_levels = books_from_oracle(eng)
+    book_sids = sorted({sid for sid, _ in oracle_levels}) or [1]
+
+    # the supervised write path, hot standby armed, one seeded SIGKILL
+    port = _free_port()
+    serve_args = ["--engine", args.engine, "--compat", "fixed",
+                  "--batch", str(args.batch),
+                  "--slots", str(args.slots),
+                  "--max-fills", str(args.max_fills),
+                  "--checkpoint-every", str(args.checkpoint_every),
+                  "--checkpoint-keep", str(args.checkpoint_keep),
+                  "--listen", f"127.0.0.1:{port}",
+                  "--idle-exit", str(args.idle_exit),
+                  "--health-every", "0.2"]
+    sup_cmd = [sys.executable, "-m", "kme_tpu.cli", "supervise",
+               "--checkpoint-dir", ckpt_dir,
+               "--stale-after", str(args.stale_after),
+               "--stall-after", str(args.stall_after),
+               "--max-restarts", str(args.max_restarts),
+               "--grace", str(args.grace),
+               "--backoff-base", "0.05", "--backoff-cap", "0.5",
+               "--standby", "--poll", "0.1", "--"] + serve_args
+    env = dict(os.environ)
+    env["KME_FAULTS"] = schedule
+    env["KME_FAULTS_STATE"] = state_dir
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.time()
+    sup = subprocess.Popen(sup_cmd, env=env)
+
+    # the feed tier: reconnect armed (and counted — the drill requires
+    # the outage to have actually hit the read path)
+    reconnects = [0]
+
+    def _factory():
+        reconnects[0] += 1
+        return TcpBroker("127.0.0.1", port, timeout=5.0)
+
+    # the supervised serve is still booting: retry the initial connect
+    boot_deadline = time.time() + 30.0
+    while True:
+        try:
+            broker0 = TcpBroker("127.0.0.1", port, timeout=5.0)
+            break
+        except OSError:
+            if time.time() > boot_deadline:
+                raise
+            time.sleep(0.2)
+    registry = Registry()
+    feed = FeedServer(broker0, port=0, topic=TOPIC_OUT,
+                      depth_every=64, registry=registry,
+                      reconnect=_factory)
+    stop_ev = threading.Event()
+    feed_thread = threading.Thread(target=feed.serve_forever,
+                                   args=(stop_ev,), daemon=True)
+    feed_thread.start()
+
+    # live subscribers, connected BEFORE the stream flows: a wildcard
+    # auditor, a single-symbol sub and a two-symbol sub
+    fh, fp = feed.address
+    sub_plans = [None, {book_sids[0]},
+                 set(book_sids[:2]) if len(book_sids) > 1
+                 else {book_sids[0]}]
+    clients = [FeedClient(fh, fp, symbols=plan, timeout=1.0)
+               for plan in sub_plans]
+    done_ev = threading.Event()
+
+    def _drain(c: FeedClient) -> None:
+        while not done_ev.is_set():
+            got = sum(1 for _ in c.recv_frames())
+            if got == 0 and done_ev.is_set():
+                return
+
+    client_threads = [threading.Thread(target=_drain, args=(c,),
+                                       daemon=True) for c in clients]
+    for th in client_threads:
+        th.start()
+
+    producer = _Producer("127.0.0.1", port, lines)
+    producer.start()
+
+    sup_rc: Optional[int] = None
+    deadline = t0 + args.timeout
+    while time.time() < deadline:
+        sup_rc = sup.poll()
+        if sup_rc is not None:
+            break
+        time.sleep(0.25)
+    if sup_rc is None:
+        print(f"kme-chaos: TIMEOUT after {args.timeout}s; killing the "
+              f"supervisor", file=sys.stderr)
+        sup.kill()
+        sup.wait()
+        sup_rc = sup.returncode
+    producer.stop.set()
+    producer.join(timeout=10.0)
+    elapsed = time.time() - t0
+
+    # the write path is gone; the feed must already hold the whole log
+    log_dir = os.path.join(ckpt_dir, "broker-log")
+    recs = read_matchout_records(log_dir)
+    caught_up = feed.offset >= len(recs)
+    lag = registry.latency("feed_lag").quantiles()
+    # stop() first: the feed is likely spinning in its reconnect loop
+    # now that the write path is gone, and only _stop breaks that
+    feed.stop()
+    stop_ev.set()
+    feed_thread.join(timeout=10.0)
+    feed.drain(timeout=10.0)
+    stats = feed.stats()
+    feed.close()                      # EOF to every subscriber
+    done_ev.set()
+    for th in client_threads:
+        th.join(timeout=10.0)
+    for c in clients:
+        c.close()
+
+    sup_state = {}
+    try:
+        with open(os.path.join(ckpt_dir, "supervisor.json")) as f:
+            sup_state = json.load(f)
+    except (OSError, ValueError):
+        pass
+    recoveries = sup_state.get("recoveries", [])
+    promoted = [r for r in recoveries if r.get("promoted")]
+    fo = [r["failover_seconds"] for r in promoted
+          if r.get("failover_seconds") is not None]
+
+    failures: List[str] = []
+    if sup_rc != 0:
+        failures.append(f"supervisor exited rc={sup_rc}")
+    if producer.sent < len(lines):
+        failures.append(f"producer only delivered {producer.sent} of "
+                        f"{len(lines)} records")
+    if not promoted:
+        failures.append("the standby never promoted")
+    elif fo and max(fo) > args.max_failover:
+        failures.append(f"failover took {max(fo):.2f}s "
+                        f"(bound {args.max_failover}s)")
+    if reconnects[0] < 1:
+        failures.append("the feed tier never reconnected — the kill "
+                        "missed the read path, the drill proves "
+                        "nothing")
+    if not caught_up:
+        failures.append(f"feed consumed {feed.offset} of {len(recs)} "
+                        f"durable MatchOut records before the write "
+                        f"path exited")
+    sub_reports = []
+    for ci, c in enumerate(clients):
+        bb = c.builder
+        want = (oracle_levels if c.symbols is None
+                else {k: v for k, v in oracle_levels.items()
+                      if k[0] in c.symbols})
+        exact = canonical_books(bb.book) == canonical_books(want)
+        sub_reports.append({
+            "symbols": (sorted(c.symbols)
+                        if c.symbols is not None else None),
+            "frames": bb.frames, "gaps": len(bb.gaps),
+            "dups": bb.dups, "resyncs": bb.resyncs,
+            "byte_exact": exact,
+        })
+        tag = f"subscriber {ci} (symbols={sub_reports[-1]['symbols']})"
+        if bb.errors:
+            failures.append(f"{tag}: {bb.errors[:2]}")
+        if bb.gaps:
+            failures.append(f"{tag}: {len(bb.gaps)} missing delta "
+                            f"seq range(s), e.g. {bb.gaps[:2]}")
+        if bb.dups:
+            failures.append(f"{tag}: {bb.dups} duplicate seq(s)")
+        if not exact:
+            failures.append(f"{tag}: book diverged from the oracle "
+                            f"replay post-promotion")
+
+    report = {
+        "ok": not failures,
+        "failures": failures,
+        "scenario": "feed-failover",
+        "seed": args.seed,
+        "events": args.events,
+        "schedule": schedule,
+        "elapsed_seconds": round(elapsed, 3),
+        "promotions": len(promoted),
+        "failover_seconds": fo,
+        "feed_reconnects": reconnects[0],
+        "feed": stats,
+        "feed_lag_p99_ms": round(lag[0.99] * 1e3, 3),
+        "subscribers": sub_reports,
+        "supervisor": sup_state,
+        "fault_fires": _fault_fires(state_dir),
+        "run_dir": run_dir,
+    }
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1)
+    status = "OK" if report["ok"] else "FAILED"
+    print(f"kme-chaos: {status} — feed-failover: promotions="
+          f"{len(promoted)} failover_seconds={fo} "
+          f"feed_reconnects={reconnects[0]} "
+          f"frames={stats['frames']} dup_suppressed="
+          f"{stats['dup_suppressed']} books="
+          f"{sum(1 for s in sub_reports if s['byte_exact'])}/"
+          f"{len(sub_reports)} byte-exact, gaps="
+          f"{sum(s['gaps'] for s in sub_reports)}, dups="
+          f"{sum(s['dups'] for s in sub_reports)}, "
+          f"elapsed={elapsed:.1f}s", file=sys.stderr)
+    for fail in failures:
+        print(f"kme-chaos: FAIL: {fail}", file=sys.stderr)
+    print(f"kme-chaos: report written to {report_path}",
+          file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
 def scenario_registry() -> dict:
     """name -> one-line description for every runnable scenario: the
-    three recovery drills plus the five adversarial storm profiles
+    four recovery drills plus the five adversarial storm profiles
     (workload.STORM_PROFILES). `kme-chaos --list-scenarios` prints it."""
     from kme_tpu.workload import STORM_PROFILES
 
@@ -746,6 +1006,10 @@ def scenario_registry() -> dict:
                           "group's leader; survivors must not dip, "
                           "merged stream byte-exact, zero duplicate "
                           "stamps",
+        "feed-failover": "market-data drill: kill the leader with "
+                         "live feed subscribers; books byte-exact "
+                         "post-promotion, zero dup/missing delta "
+                         "seqs",
     }
     for name, prof in STORM_PROFILES.items():
         reg[name] = (f"storm: {prof.summary} (adaptive overload "
@@ -1077,7 +1341,8 @@ def main(argv=None) -> int:
                    help="print the scenario registry (name + one-line "
                         "description) and exit")
     p.add_argument("--scenario",
-                   choices=("default", "failover", "shard-failover")
+                   choices=("default", "failover", "shard-failover",
+                            "feed-failover")
                    + tuple(STORM_PROFILES),
                    default="default",
                    help="default = the at-least-once recovery gauntlet "
@@ -1205,6 +1470,10 @@ def main(argv=None) -> int:
         report_path = args.report or os.path.join(
             run_dir, "chaos-report.json")
         return run_shard_failover(args, run_dir, report_path)
+    if args.scenario == "feed-failover":
+        report_path = args.report or os.path.join(
+            run_dir, "chaos-report.json")
+        return run_feed_failover(args, run_dir, report_path)
     if args.scenario in STORM_PROFILES:
         report_path = args.report or os.path.join(
             run_dir, "chaos-report.json")

@@ -349,6 +349,16 @@ def consume_main(argv=None) -> int:
     return _main(argv)
 
 
+def feed_main(argv=None) -> int:
+    """Market-data fan-out server (ISSUE 13): book deltas, depth
+    snapshots, subscriber filtering, conflation."""
+    try:
+        from kme_tpu.feed.server import main as _main
+    except ImportError:
+        return _not_yet("the feed tier")
+    return _main(argv)
+
+
 def provision_main(argv=None) -> int:
     """Topic provisioner — the topic.js role."""
     try:
@@ -515,8 +525,15 @@ def agg_main(argv=None) -> int:
 
     sources = list(args.sources)
     if args.state_root:
+        import os
+
         eps = discover_endpoints(args.state_root)
         sources.extend(g["health"] for g in eps["groups"])
+        # feed-tier heartbeats are optional surfaces: only scrape the
+        # ones that exist, so absent feeds don't add DEGRADED rows
+        for fp in [eps["feed"]] + [g["feed"] for g in eps["groups"]]:
+            if os.path.exists(fp):
+                sources.append(fp)
     if not sources:
         p.error("no sources: give URLs/paths or --state-root")
     snaps = []
@@ -742,7 +759,7 @@ def main(argv=None) -> int:
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
         "supervise", "standby", "trace", "chaos", "top", "lint",
-        "front", "agg"))
+        "front", "agg", "feed"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
@@ -752,7 +769,7 @@ def main(argv=None) -> int:
             "supervise": supervise_main, "standby": standby_main,
             "trace": trace_main, "chaos": chaos_main,
             "top": top_main, "lint": lint_main, "front": front_main,
-            "agg": agg_main,
+            "agg": agg_main, "feed": feed_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

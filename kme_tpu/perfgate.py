@@ -77,6 +77,12 @@ GATED_METRICS = {
     # tolerance (BASELINE_wire.json)
     "ingress_msgs_per_sec": "up",
     "wire_parse_s": "down",
+    # market-data fan-out (ISSUE r13): frames delivered to subscriber
+    # sockets per second of fan-out wall, and the admission-stamp ->
+    # frame-derivation p99 — wall-clock metrics, gated vs
+    # BASELINE_feed.json on CPU with the host-gate tolerance
+    "feed_msgs_per_sec": "up",
+    "feed_lag_p99_ms": "down",
 }
 
 # reported-only: too noisy to gate on (documented flappers)

@@ -602,6 +602,20 @@ def aggregate(snaps: Sequence[Tuple[str, dict]],
                   "transfer_shortfall_total"):
             if k in c:
                 row[k] = c[k]
+        if "feed_subscribers" in g:
+            # feed-tier source (kme-feed heartbeat): fan-out health
+            # rides the same per-source row; extras render generically
+            delivered = c.get("feed_delivered_total", 0)
+            dropped = c.get("feed_conflated_frames_total", 0)
+            offered = delivered + dropped
+            row["feed_subs"] = g["feed_subscribers"]
+            row["feed_delivered"] = delivered
+            row["feed_conflation"] = (round(dropped / offered, 4)
+                                      if offered else 0.0)
+            fl = lats.get("feed_lag") or {}
+            if fl:
+                row["feed_lag_p50_ms"] = fl.get("p50_ms")
+                row["feed_lag_p99_ms"] = fl.get("p99_ms")
         rows.append(row)
         for ex in snap.get("exemplars") or ():
             exemplars.append(dict(ex, source=name))

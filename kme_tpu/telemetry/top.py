@@ -95,6 +95,7 @@ def discover_endpoints(state_root: str) -> dict:
         "leader": os.path.join(state_root, "serve.health"),
         "standby": os.path.join(state_root, "standby.health"),
         "supervisor": os.path.join(state_root, "supervisor.json"),
+        "feed": os.path.join(state_root, "feed.health"),
         "groups": [],
     }
     try:
@@ -108,15 +109,18 @@ def discover_endpoints(state_root: str) -> dict:
                 "k": int(name[5:]),
                 "health": os.path.join(st, "serve.health"),
                 "supervisor": os.path.join(st, "supervisor.json"),
+                "feed": os.path.join(st, "feed.health"),
             })
     return eps
 
 
 def collect(leader: Optional[str], standby: Optional[str],
-            supervisor: Optional[str], now: Optional[float] = None) -> dict:
+            supervisor: Optional[str], now: Optional[float] = None,
+            feed: Optional[str] = None) -> dict:
     return {"t": time.monotonic() if now is None else now,
             "leader": scrape(leader), "standby": scrape(standby),
-            "supervisor": read_supervisor(supervisor)}
+            "supervisor": read_supervisor(supervisor),
+            "feed": scrape(feed)}
 
 
 def collect_cluster(groups, now: Optional[float] = None) -> dict:
@@ -126,7 +130,8 @@ def collect_cluster(groups, now: Optional[float] = None) -> dict:
     for g in groups:
         rows.append({"k": g["k"], "node": scrape(g.get("health")),
                      "supervisor": read_supervisor(
-                         g.get("supervisor"))})
+                         g.get("supervisor")),
+                     "feed": scrape(g.get("feed"))})
     return {"t": time.monotonic() if now is None else now,
             "rows": rows}
 
@@ -179,6 +184,38 @@ def _fmt(v, nd=1) -> str:
     if isinstance(v, float):
         return f"{v:,.{nd}f}"
     return f"{v:,}"
+
+
+def feed_lines(node: dict, indent: str = "") -> list:
+    """The feed-tier rows (kme-feed fan-out metrics) for one scraped
+    node — shared by the single-pair and --cluster frames. Conflation
+    rate = frames dropped into conflated-TOB mode over frames offered
+    to subscriber queues (delivered + dropped)."""
+    delivered = _counter(node, "feed_delivered_total") or 0
+    dropped = _counter(node, "feed_conflated_frames_total") or 0
+    offered = delivered + dropped
+    rate = (dropped / offered) if offered else 0.0
+    lat = (node.get("metrics", {}).get("latencies", {})
+           .get("feed_lag") or {})
+    lines = [
+        f"{indent}feed     subs="
+        f"{_fmt(_gauge(node, 'feed_subscribers'), 0)} "
+        f"group={_fmt(_gauge(node, 'feed_group'), 0)} "
+        f"offset={_fmt(_gauge(node, 'feed_offset'), 0)} "
+        f"frames={_fmt(_counter(node, 'feed_frames_total'), 0)} "
+        f"delivered={_fmt(delivered, 0)}",
+        f"{indent}  conflation rate={rate:.1%} "
+        f"cycles={_fmt(_counter(node, 'feed_conflations_total'), 0)} "
+        f"resyncs={_fmt(_counter(node, 'feed_resyncs_total'), 0)} "
+        f"snapshots="
+        f"{_fmt(_counter(node, 'feed_snapshots_served_total'), 0)} "
+        f"disconnects="
+        f"{_fmt(_counter(node, 'feed_disconnects_total'), 0)}",
+        f"{indent}  feed_lag p50={_fmt(lat.get('p50_ms'), 3)}ms "
+        f"p99={_fmt(lat.get('p99_ms'), 3)}ms "
+        f"({_fmt(lat.get('count'), 0)} obs)",
+    ]
+    return lines
 
 
 def render(view: dict, width: int = 78) -> list:
@@ -313,6 +350,14 @@ def render(view: dict, width: int = 78) -> list:
                 f"p50={_fmt(rtt.get('p50_ms'), 3)}ms "
                 f"p99={_fmt(rtt.get('p99_ms'), 3)}ms")
 
+    # feed-tier row (kme-feed fan-out, --state-root feed.health): only
+    # rendered when the feed gauges are present — absent on runs with
+    # no market-data tier
+    feedn = view.get("feed") or {}
+    if _gauge(feedn, "feed_subscribers") is not None:
+        lines.append("")
+        lines.extend(feed_lines(feedn))
+
     lines.append("")
     if stby.get("source"):
         hb = stby.get("hb") or {}
@@ -383,6 +428,15 @@ def render_cluster(cur: dict, prev: Optional[dict] = None,
             f"{_fmt(lag, 0):>8s}"
             f"{_fmt(shed, 0):>8s}"
             f"{_fmt(sup.get('restarts_total'), 0):>9s}")
+    # feed tier, one block per group that publishes the feed gauges
+    feed_rows = [(row["k"], row.get("feed") or {}) for row in cur["rows"]
+                 if _gauge(row.get("feed") or {}, "feed_subscribers")
+                 is not None]
+    if feed_rows:
+        lines.append("  feed tier:")
+        for k, node in feed_rows:
+            for ln in feed_lines(node, indent="  "):
+                lines.append(ln.replace("feed     ", f"g{k} feed  ", 1))
     lines.append(bar)
     lines.append(f"  {up}/{len(cur['rows'])} groups up")
     return lines
@@ -399,7 +453,8 @@ def _curses_loop(args) -> int:
         scr.nodelay(True)
         prev = None
         while True:
-            cur = collect(args.leader, args.standby, args.supervisor)
+            cur = collect(args.leader, args.standby, args.supervisor,
+                          feed=args.feed)
             view = build_view(cur, prev)
             prev = cur
             scr.erase()
@@ -431,6 +486,11 @@ def main(argv=None) -> int:
     p.add_argument("--supervisor", default=None, metavar="PATH",
                    help="supervisor state mirror "
                         "(<checkpoint-dir>/supervisor.json)")
+    p.add_argument("--feed", default=None, metavar="URL|PATH",
+                   help="feed-tier metrics URL or heartbeat file "
+                        "(kme-feed --state-root writes feed.health); "
+                        "the feed section renders iff its gauges are "
+                        "present")
     p.add_argument("--state-root", default=None, metavar="DIR",
                    help="convenience: a checkpoint dir (or a multi-"
                         "leader run dir with group{k}/ children); "
@@ -454,6 +514,7 @@ def main(argv=None) -> int:
         args.leader = args.leader or eps["leader"]
         args.standby = args.standby or eps["standby"]
         args.supervisor = args.supervisor or eps["supervisor"]
+        args.feed = args.feed or eps["feed"]
     if args.cluster:
         if eps is None or not eps["groups"]:
             p.error("--cluster needs --state-root pointing at a run "
@@ -482,9 +543,11 @@ def main(argv=None) -> int:
     if args.once:
         prev = None
         if not args.no_rate_sample:
-            prev = collect(args.leader, args.standby, args.supervisor)
+            prev = collect(args.leader, args.standby, args.supervisor,
+                           feed=args.feed)
             time.sleep(min(args.interval, 1.0))
-        cur = collect(args.leader, args.standby, args.supervisor)
+        cur = collect(args.leader, args.standby, args.supervisor,
+                      feed=args.feed)
         for ln in render(build_view(cur, prev)):
             print(ln)
         return 0
@@ -498,7 +561,7 @@ def main(argv=None) -> int:
         try:
             while True:
                 cur = collect(args.leader, args.standby,
-                              args.supervisor)
+                              args.supervisor, feed=args.feed)
                 for ln in render(build_view(cur, prev)):
                     print(ln)
                 prev = cur

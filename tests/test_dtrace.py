@@ -410,3 +410,25 @@ def test_discover_endpoints_and_cluster_render(tmp_path):
     # group1 never wrote a heartbeat: a degraded row, not a crash
     assert "DEGRADED" in text
     assert "1/2 groups up" in text
+
+
+def test_aggregate_feed_rows_carry_fanout_health():
+    """kme-agg (ISSUE 13): a scraped kme-feed heartbeat contributes a
+    per-source row with subscriber count, conflation rate and feed
+    lag; sources without feed gauges are untouched."""
+    feed_snap = {
+        "counters": {"feed_delivered_total": 900,
+                     "feed_conflated_frames_total": 100},
+        "gauges": {"feed_subscribers": 7},
+        "latencies": {"feed_lag": {
+            "count": 900, "sum_s": 0.5, "p50_ms": 0.4, "p90_ms": 1.0,
+            "p99_ms": 2.5, "p999_ms": 4.0}}}
+    plain = {"counters": {}, "gauges": {}, "latencies": {}}
+    agg = dtrace.aggregate([("feed", feed_snap), ("g0", plain)])
+    rows = {r["source"]: r for r in agg["per_group"]}
+    assert rows["feed"]["feed_subs"] == 7
+    assert rows["feed"]["feed_conflation"] == pytest.approx(0.1)
+    assert rows["feed"]["feed_lag_p99_ms"] == 2.5
+    assert "feed_subs" not in rows["g0"]
+    text = dtrace.render_agg(agg)
+    assert "feed_subs=7" in text and "feed_conflation=0.1" in text

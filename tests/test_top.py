@@ -308,3 +308,46 @@ def test_top_live_leader_standby_pair(tmp_path, capsys):
         if rep.metrics_server is not None:
             rep.metrics_server.shutdown()
     assert rc[0] == 0
+
+
+def test_feed_section_gated_on_feed_gauges():
+    """The feed tier renders iff a scraped feed source carries the
+    feed gauges (ISSUE 13); absent feeds leave the frame unchanged."""
+    from kme_tpu.telemetry.top import feed_lines
+
+    feed = _node(gauges={"feed_subscribers": 12, "feed_group": 0,
+                         "feed_offset": 900})
+    feed["metrics"]["counters"] = {
+        "feed_frames_total": 300, "feed_delivered_total": 3600,
+        "feed_conflated_frames_total": 400,
+        "feed_conflations_total": 2, "feed_resyncs_total": 2,
+        "feed_snapshots_served_total": 12,
+        "feed_disconnects_total": 1}
+    feed["metrics"]["latencies"] = {
+        "feed_lag": {"count": 3600, "sum_s": 1.0, "p50_ms": 0.8,
+                     "p90_ms": 2.0, "p99_ms": 4.5, "p999_ms": 9.0}}
+    view = build_view({"t": 1.0, "leader": _node(records=5),
+                       "standby": _node(), "supervisor": None,
+                       "feed": feed})
+    text = "\n".join(render(view))
+    assert "feed     subs=12" in text
+    assert "conflation rate=10.0%" in text     # 400 / (3600 + 400)
+    assert "feed_lag p50=0.800ms p99=4.500ms" in text
+    assert "snapshots=12" in text and "disconnects=1" in text
+    # indent-prefixed variant used by the --cluster frame
+    assert feed_lines(feed, indent="  ")[0].startswith("  feed")
+    # no feed source (or one without the gauges): section absent
+    view = build_view({"t": 1.0, "leader": _node(records=5),
+                       "standby": _node(), "supervisor": None,
+                       "feed": _node()})
+    assert "feed " not in "\n".join(render(view))
+
+
+def test_discover_endpoints_include_feed_surfaces(tmp_path):
+    from kme_tpu.telemetry.top import discover_endpoints
+
+    os.makedirs(tmp_path / "group0" / "state")
+    eps = discover_endpoints(str(tmp_path))
+    assert eps["feed"] == str(tmp_path / "feed.health")
+    assert eps["groups"][0]["feed"] == str(
+        tmp_path / "group0" / "state" / "feed.health")
