@@ -49,12 +49,22 @@ RULES: Dict[str, str] = {
 HOT_SCOPES: Dict[str, Set[str]] = {
     "kme_tpu/bridge/service.py": {"_step_pipelined", "_parse_batch"},
     "kme_tpu/runtime/seqsession.py": {"submit", "_plan"},
-    "kme_tpu/native/sched.py": {"plan_batch", "apply_placement"},
+    "kme_tpu/native/sched.py": {"plan_batch", "apply_placement",
+                                "slice_windows"},
     # the mesh planner + elastic placement decision run per batch on
     # the host between dispatches; the MIGRATION executors
     # (_migrate/_maybe_rebalance) legitimately sync the state pytree
-    # and are NOT listed, like the collect-side functions above
-    "kme_tpu/parallel/seqmesh.py": {"plan_windows", "plan_rebalance"},
+    # and are NOT listed, like the collect-side functions above.
+    # Async dispatch (r14) adds the submit-side windows: the dispatch
+    # planner, the per-shard stage+submit step, and the dependency
+    # patcher all sit between queue pop and device dispatch — a host
+    # sync there re-serializes the per-chip streams. The collect
+    # barrier (_collect_merge/_dispatch_async walls) legitimately
+    # syncs and is NOT listed.
+    "kme_tpu/parallel/seqmesh.py": {"plan_windows", "plan_rebalance",
+                                    "plan_dispatch",
+                                    "_stage_and_dispatch",
+                                    "_patch_shard"},
     # the front door's merge loop sits on the serving path of EVERY
     # group's consumer — a blocking call here stalls the global feed;
     # accept_frames is the binary front door itself (one C call per

@@ -489,6 +489,10 @@ def bench_pipeline(events: int = 40_960, symbols: int = 32,
         "collect_wall_s": round(collect_wall, 4),
         "measured_overlap_frac": round(
             overlap_s / max(collect_wall, 1e-9), 4),
+        # fraction of the H2D staging wall that ran while an earlier
+        # batch was still in flight on the device (r14 double-buffer
+        # surface; advisory-up in the gate — it rides wall clocks)
+        "h2d_overlap_frac": ses.h2d_overlap_frac,
         # the host-path wall the native layer exists to shrink:
         # bytes->columns parse + route/pack plan + output recon
         "local_s": round(local_s, 4),
@@ -513,6 +517,12 @@ def bench_pipeline(events: int = 40_960, symbols: int = 32,
             "< 0.5 — less than half the collect wall was hidden under "
             "device execution")
         print(f"kme-bench: WARNING {detail['pipeline_warning']}",
+              file=sys.stderr)
+    if detail["h2d_overlap_frac"] < 0.5:
+        detail["h2d_warning"] = (
+            f"h2d_overlap_frac {detail['h2d_overlap_frac']} < 0.5 — "
+            "most input staging ran with the device idle")
+        print(f"kme-bench: WARNING {detail['h2d_warning']}",
               file=sys.stderr)
     publish_pipeline_gauges(ses.telemetry, detail)
     return {
@@ -1090,7 +1100,8 @@ def bench_shards(events: int = 4000, symbols: int = 8,
                  accounts: int = 32, seed: int = 0,
                  workload: str = "zipf-hot",
                  shards_list=(1, 2, 4), slots: int = 128,
-                 max_fills: int = 16, slice_size: int = 500) -> dict:
+                 max_fills: int = 16, slice_size: int = 500,
+                 dispatch: str = "auto") -> dict:
     """Elastic-sharding suite (`--suite shards`): the skewed workload
     through SeqMeshSession at every shard count, with byte parity
     asserted against the scalar fixed-mode oracle and MIGRATIONS
@@ -1101,6 +1112,17 @@ def bench_shards(events: int = 4000, symbols: int = 8,
     imbalance, so the report carries both `shard_imbalance` (elastic,
     perfgate-gated, down-is-better) and `shard_imbalance_static` (the
     adversary's score the elastic planner must beat).
+
+    Per-chip async dispatch (r14) grows the suite three ways, all at
+    the top shard count: a `--dispatch lockstep` control run re-asserts
+    byte parity for the legacy mesh scan (the async-vs-lockstep parity
+    leg CI runs), the report carries `chip_stall_frac` /
+    `chip_stall_frac_lockstep` from the deterministic dispatch
+    simulation (replay-stable — chip_stall_frac is perfgate-GATED
+    down, and on zipf-hot async must strictly beat lockstep), and a
+    wall_feed=True advisory run exercises the wall-fed rebalancer EWMA
+    (parity asserted; its imbalance is reported but never gated — the
+    fed walls are real clocks, so its placement drifts run to run).
 
     Runs on a CPU mesh when XLA_FLAGS=--xla_force_host_platform_
     device_count=N provides the virtual devices (the CI smoke) and
@@ -1137,8 +1159,9 @@ def bench_shards(events: int = 4000, symbols: int = 8,
                        max_fills=max_fills, pos_cap=1 << 10,
                        probe_max=8)
 
-    def run(shards, rebalance):
-        ses = SeqMeshSession(cfg, shards, rebalance=rebalance)
+    def run(shards, rebalance, mode=dispatch, wall_feed=False):
+        ses = SeqMeshSession(cfg, shards, rebalance=rebalance,
+                             dispatch=mode, wall_feed=wall_feed)
         got = []
         t0 = time.perf_counter()
         for lo in range(0, len(msgs), slice_size):
@@ -1147,14 +1170,16 @@ def bench_shards(events: int = 4000, symbols: int = 8,
         wall = time.perf_counter() - t0
         if got != want:
             raise AssertionError(
-                f"shards={shards} rebalance={rebalance}: MatchOut "
-                f"diverged from the single-chip oracle "
+                f"shards={shards} rebalance={rebalance} "
+                f"dispatch={ses.dispatch}: MatchOut diverged from the "
+                f"single-chip oracle "
                 f"({sum(a != b for a, b in zip(got, want))} lines + "
                 f"{abs(len(got) - len(want))} length delta)")
         return ses, wall
 
     per_shards = []
     elastic_top = None
+    top_ses = None
     for shards in shards_list:
         ses, wall = run(shards, rebalance=True)
         stats = ses.shard_stats()
@@ -1168,15 +1193,25 @@ def bench_shards(events: int = 4000, symbols: int = 8,
         # deterministic shard_imbalance is meant to enforce here
         rec = {"shards": shards, "wall_s": round(wall, 3),
                "msgs_per_sec": round(len(msgs) / wall, 1),
-               "parity": "byte-exact", **stats}
+               "parity": "byte-exact", "dispatch": ses.dispatch,
+               **stats}
+        if ses.dispatch == "async":
+            # per-shard-count copies use NON-gated names on purpose:
+            # per_shards serializes before the top-level detail keys
+            # and the gate regex takes the FIRST occurrence of each
+            # GATED_METRICS name in the artifact text
+            rec.update({f"run_{k}": v
+                        for k, v in ses.stall_stats().items()})
         per_shards.append(rec)
         if shards == need:
             elastic_top = rec
+            top_ses = ses
     _static_ses, static_wall = run(need, rebalance=False)
     static = _static_ses.shard_stats()
     detail = {
         "suite": "shards", "workload": workload, "events": len(msgs),
         "slice_size": slice_size, "shard_counts": list(shards_list),
+        "dispatch": elastic_top["dispatch"],
         "per_shards": per_shards,
         "shard_imbalance": elastic_top["imbalance"],
         "shard_imbalance_static": static["imbalance"],
@@ -1187,6 +1222,36 @@ def bench_shards(events: int = 4000, symbols: int = 8,
         "note": "byte parity asserted vs the scalar oracle at every "
                 "shard count; migrations required at shards > 1",
     }
+    if top_ses is not None and top_ses.dispatch == "async":
+        stall = top_ses.stall_stats()
+        # the stall fractions come from the deterministic dispatch
+        # simulation (weighted message costs, both schedules replayed
+        # on the same placements) — replay-stable, so chip_stall_frac
+        # is safe to gate and safe to hard-assert against its own
+        # lockstep twin on the skewed workload
+        detail.update(stall)
+        if (workload == "zipf-hot" and need > 1
+                and stall["chip_stall_frac"]
+                >= stall["chip_stall_frac_lockstep"]):
+            raise AssertionError(
+                f"async dispatch did not reduce chip stall on "
+                f"zipf-hot at shards={need}: async "
+                f"{stall['chip_stall_frac']} >= lockstep "
+                f"{stall['chip_stall_frac_lockstep']}")
+        # async-vs-lockstep parity leg: the legacy mesh scan must still
+        # produce the same bytes (run() asserts vs the oracle, which
+        # both modes must match — transitively async == lockstep)
+        _lock_ses, lock_wall = run(need, rebalance=True,
+                                   mode="lockstep")
+        detail["lockstep_wall_s"] = round(lock_wall, 3)
+        # wall_feed advisory leg: real per-shard walls folded into the
+        # rebalancer EWMA; parity holds (placement-independent), but
+        # the resulting imbalance rides wall clocks so it is reported,
+        # never gated
+        _wf_ses, wf_wall = run(need, rebalance=True, wall_feed=True)
+        detail["wall_feed_wall_s"] = round(wf_wall, 3)
+        detail["wall_feed_imbalance"] = _wf_ses.shard_stats()[
+            "imbalance"]
     if detail["shard_imbalance"] >= detail["shard_imbalance_static"]:
         detail["imbalance_warning"] = (
             f"elastic imbalance {detail['shard_imbalance']} did not "
@@ -1985,6 +2050,11 @@ def main(argv=None) -> int:
                         "margin granted per cross-shard transfer pair "
                         "(front.py chunked reserve->settle; 1 = exact "
                         "per-order grants)")
+    p.add_argument("--dispatch", choices=("auto", "async", "lockstep"),
+                   default="auto",
+                   help="shards suite: mesh dispatch mode (auto "
+                        "resolves to per-chip async on a single-host "
+                        "mesh; lockstep is the legacy barrier scan)")
     # None -> per-suite default: the native/parity suites judge java
     # (their reason to exist); the lanes/seq headline is fixed-mode
     # unless java is explicitly requested
@@ -2086,7 +2156,8 @@ def main(argv=None) -> int:
                                      if args.workload != "zipf"
                                      else "zipf-hot"),
                            slots=args.slots or 128,
-                           max_fills=args.max_fills)
+                           max_fills=args.max_fills,
+                           dispatch=args.dispatch)
     elif args.suite == "storms":
         rec = bench_storms(args.events or 4000, seed=args.seed)
     elif args.suite == "wire":

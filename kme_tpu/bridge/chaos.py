@@ -464,6 +464,12 @@ def run_shard_failover(args, run_dir: str, report_path: str) -> int:
                       "--journal-out",
                       os.path.join(ckpt, "journal.bin"),
                       "--trace-spans"]
+        if args.engine == "seq":
+            # pipelined submit/collect arms the async dispatch + H2D
+            # double-buffer path (r14) inside each group's leader, so
+            # the failover drill exercises promotion/replay against
+            # in-flight device work rather than the serial loop
+            serve_args += ["--pipeline", "1"]
         sup_cmd = [sys.executable, "-m", "kme_tpu.cli", "supervise",
                    "--checkpoint-dir", ckpt,
                    "--stale-after", str(args.stale_after),

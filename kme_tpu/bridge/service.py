@@ -1152,6 +1152,13 @@ class MatchService:
                     "mean wire-frame decode cost per binary "
                     "record (ns)").set(
                 round(self.broker.wire_parse_ns / nbin) if nbin else 0)
+        ov = getattr(self._session, "h2d_overlap_frac", None)
+        if ov:
+            # stage-transfer overlap surface (r14): fraction of H2D
+            # staging wall hidden under in-flight device execution
+            t.gauge("h2d_overlap_frac",
+                    "fraction of host->device staging time "
+                    "overlapped with device execution").set(ov)
         ctl = getattr(self.broker, "overload", None)
         if ctl is not None:
             # adaptive-controller surface (kme-top shows a degradation

@@ -1773,6 +1773,32 @@ def export_canonical(cfg: SeqConfig, state) -> dict:
     }
 
 
+# the replicated balance planes (account a -> row a>>7, lane a&127):
+# the only cross-shard-coupled state the seqmesh async dispatcher
+# forwards point-to-point and select-merges at barriers
+BAL_KEYS = ("bal_lo", "bal_hi", "bal_u")
+
+
+def select_balances(planes_by_shard, sel) -> dict:
+    """Merge per-shard copies of the replicated balance planes by
+    per-account OWNER SELECTION: sel[a] names the shard whose copy of
+    account a is authoritative. Exact by construction — under the
+    seqmesh window invariant an account's balance only ever advances on
+    the shard it is currently bound to, so a select needs no arithmetic
+    merge (and trivially preserves Java-long wrap).
+
+    planes_by_shard: per-shard dicts of BAL_KEYS -> (arows, 128) i32.
+    sel: (arows*128,) int shard index per flat account slot.
+    Returns merged (arows, 128) planes."""
+    stacked = {k: np.stack([p[k] for p in planes_by_shard])
+               for k in BAL_KEYS}
+    arows, lanes = stacked[BAL_KEYS[0]].shape[1:]
+    idx = sel.reshape(arows, lanes)
+    r = np.arange(arows, dtype=np.int64)[:, None]
+    c = np.arange(lanes, dtype=np.int64)[None, :]
+    return {k: stacked[k][idx, r, c] for k in BAL_KEYS}
+
+
 def import_canonical(cfg: SeqConfig, canon: dict):
     """Inverse of export_canonical (numpy -> device plane dict). The
     snapshot's slot depth and account capacity may be SMALLER than the

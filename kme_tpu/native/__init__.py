@@ -246,6 +246,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                            + [P64] * 6 + [c.c_int32], c.c_int64),
         "kme_pack_planes": ([c.c_void_p], P32),
         "kme_pack_err_index": ([c.c_void_p], c.c_int64),
+        # per-shard async-dispatch window slicing (kme_host.cpp)
+        "kme_shard_slice": ([P32] + [c.c_int64] * 4 + [P64]
+                            + [c.c_int64] * 2 + [P32], None),
         # native one-pass batch reconstruction (kme_wire.cpp)
         "kme_recon_batch": ([c.c_int64] + [P64] * 6
                             + [P64, c.POINTER(c.c_uint8)] * 2

@@ -434,4 +434,22 @@ int64_t kme_pack_err_index(void* p) {
   return static_cast<Pack*>(p)->err_index;
 }
 
+// Per-shard submission-queue slice (seqmesh async dispatch): gather
+// one shard's rows for `n` windows out of a stacked (K, shards*bw)
+// int32 plane into a dense zero-padded (kpad, bw) segment plane. One
+// memcpy per window row; out-of-range window indices are skipped (the
+// Python wrapper never produces them — defensive only).
+void kme_shard_slice(const int32_t* src, int64_t K, int64_t shards,
+                     int64_t bw, int64_t shard, const int64_t* win_idx,
+                     int64_t n, int64_t kpad, int32_t* dst) {
+  if (kpad > 0)
+    std::memset(dst, 0, sizeof(int32_t) * (size_t)(kpad * bw));
+  for (int64_t i = 0; i < n && i < kpad; ++i) {
+    const int64_t w = win_idx[i];
+    if (w < 0 || w >= K) continue;
+    std::memcpy(dst + i * bw, src + (w * shards + shard) * bw,
+                sizeof(int32_t) * (size_t)bw);
+  }
+}
+
 }  // extern "C"

@@ -224,6 +224,44 @@ def apply_placement(perm, lanes, s_local: int):
     return slot, slot // s_local, slot % s_local
 
 
+def slice_windows(wins: dict, win_idx, shard: int, shards: int,
+                  bw: int) -> dict:
+    """Slice ONE shard's rows for a set of windows out of the stacked
+    (K, shards*bw) i32 scan-input planes into dense (kpad, bw) per-field
+    segment planes, zero-padded to a pow2 window count (padding rows
+    are all-zero NOP windows, a no-op through the kernel). This is the
+    per-shard submission-queue staging step of the seqmesh async
+    dispatcher — hot scope: one native call per field (kme_shard_slice,
+    kme_host.cpp) with a byte-exact numpy-view fallback, no implicit
+    host syncs or allocations beyond the output planes."""
+    from kme_tpu.utils import pow2_bucket
+
+    n = len(win_idx)
+    kpad = pow2_bucket(max(n, 1), lo=1)
+    idx = np.fromiter(win_idx, np.int64, n)
+    out = {}
+    lib = load_library()
+    if lib is not None and hasattr(lib, "kme_shard_slice"):
+        P32 = ctypes.POINTER(ctypes.c_int32)
+        P64 = ctypes.POINTER(ctypes.c_int64)
+        iptr = idx.ctypes.data_as(P64)
+        for f, v in wins.items():
+            src = check_buffer(f"slice_windows.{f}", v.reshape(-1),
+                               np.int32, v.shape[0] * shards * bw)
+            dst = np.zeros((kpad, bw), np.int32)
+            lib.kme_shard_slice(
+                src.ctypes.data_as(P32), v.shape[0], shards, bw,
+                shard, iptr, n, kpad, dst.ctypes.data_as(P32))
+            out[f] = dst
+        return out
+    for f, v in wins.items():
+        dst = np.zeros((kpad, bw), np.int32)
+        if n:
+            dst[:n] = v.reshape(v.shape[0], shards, bw)[idx, shard]
+        out[f] = dst
+    return out
+
+
 # -- batch host-path entry points (one C++ call per stage) ----------------
 #
 # The serve/bench hot loop's host work — envelope check + route + H2D

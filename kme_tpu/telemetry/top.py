@@ -308,22 +308,38 @@ def render(view: dict, width: int = 78) -> list:
     nshards = _gauge(lead, "shard_count")
     if nshards:
         lines.append("")
-        lines.append(
+        head = (
             f"  shards={_fmt(nshards, 0)} "
             f"imbalance={_fmt(_gauge(lead, 'shard_imbalance'), 3)} "
             f"migrations="
             f"{_fmt(_counter(lead, 'shard_migrations_total'), 0)} "
             f"rebalances="
             f"{_fmt(_counter(lead, 'shard_rebalances_total'), 0)}")
+        # per-chip timing gauges only exist under async dispatch (r14);
+        # their absence means a lockstep mesh — no stall column, and
+        # the histograms fall back to occupancy-weighted splits
+        stall = _gauge(lead, "chip_stall_frac")
+        if stall is not None:
+            head += f" stall={stall:.1%}"
+        lines.append(head)
+        has_stall = any(
+            _gauge(lead, f"shard{s}_stall_frac") is not None
+            for s in range(int(nshards)))
         lines.append(f"  {'shard':<9s}{'occupancy':>10s}{'p50 ms':>12s}"
-                     f"{'p99 ms':>12s}")
+                     f"{'p99 ms':>12s}"
+                     + (f"{'stall%':>9s}" if has_stall else ""))
         for s in range(int(nshards)):
             v = lats.get(f"device_shard{s}") or {}
-            lines.append(
+            row = (
                 f"  {s:<9d}"
                 f"{_fmt(_gauge(lead, f'shard{s}_occupancy'), 0):>10s} "
                 f"{_fmt(v.get('p50_ms'), 3):>11s} "
                 f"{_fmt(v.get('p99_ms'), 3):>11s}")
+            if has_stall:
+                sf = _gauge(lead, f"shard{s}_stall_frac")
+                row += (f" {sf * 100:>7.1f}%" if sf is not None
+                        else f" {'-':>8s}")
+            lines.append(row)
 
     # multi-leader shard group (bridge/front.py scale-out): the
     # leader's place in the group universe, its input lag, and the
