@@ -995,6 +995,455 @@ def run_feed_failover(args, run_dir: str, report_path: str) -> int:
     return 0 if report["ok"] else 1
 
 
+def run_reshard_storm(args, run_dir: str, report_path: str) -> int:
+    """--scenario reshard-under-storm: the live N→M topology drill
+    (ROADMAP item 2). A funded flash-crowd workload is split across N
+    shard groups; at a batch barrier mid-stream the old generation
+    drains and the reshard coordinator (bridge/reshard.py) fences the
+    old epochs durably, migrates book/position state through the
+    checkpoint codec and settles consolidated balances with stamped
+    transfer legs — eating one REAL mid-settle SIGKILL and re-running
+    to the identical end state — then an M-group new generation resumes
+    the suffix over the multi-host front links (front.FrontLinks, real
+    TCP, reconnect-with-resume off the out_seq cursor). Passes iff:
+
+    - BYTE PARITY across both generations: each group's deduped durable
+      MatchOut + Xfer merge equals the single-leader oracle partitioned
+      by the pre/post topologies (front.verify_groups_reshard — the
+      resharding-is-pure-topology contract);
+    - ZERO duplicate (epoch, out_seq) stamps in ANY durable log of
+      either generation, MatchIn included: the crashed coordinator's
+      replayed legs and the front's reconnect re-sends must have been
+      watermark-suppressed, never appended twice;
+    - the settlement survived the crash EXACTLY ONCE: every journaled
+      leg appears exactly once in its group's durable MatchIn, the
+      re-run visibly suppressed the pre-crash copies, and every new
+      group's final pending_reserve checkpoint ledger counts exactly
+      coordinator legs + front reserve legs with zero rejects;
+    - every old group's log is DURABLY re-fenced (probe_fenced: a
+      stale-epoch produce raises BrokerFenced even on a fresh reload);
+    - bounded dip: the migration pause (old-generation drain → first
+      new-generation progress) stays under --reshard-pause seconds and
+      the new generation's final lat_e2e p99 under --reshard-p99-ms
+      (the settlement legs are admitted while no leader is up, so that
+      histogram deliberately swallows the migration gap).
+    """
+    import collections
+    import signal as _signal
+
+    from kme_tpu import opcodes as op
+    from kme_tpu.bridge import front
+    from kme_tpu.bridge import reshard as reshard_mod
+    from kme_tpu.bridge.broker import BrokerError
+    from kme_tpu.bridge.consume import DedupRing
+    from kme_tpu.bridge.provision import group_topics, provision
+    from kme_tpu.bridge.tcp import TcpBroker
+    from kme_tpu.runtime import checkpoint as ck
+    from kme_tpu.wire import dumps_order, parse_order
+    from kme_tpu.workload import cross_account_stream
+
+    n, m = args.groups, args.groups_to
+    engine = args.engine
+    if engine != "oracle":
+        print(f"kme-chaos: reshard surgery runs on oracle snapshots; "
+              f"overriding --engine {engine} -> oracle", file=sys.stderr)
+        engine = "oracle"
+    # wide universes keep every group busy under BOTH topologies (the
+    # shard-failover sizing rule, applied to max(n, m))
+    symbols = max(args.symbols, 64 * max(n, m))
+    accounts = max(args.accounts, 8 * max(n, m))
+    msgs = cross_account_stream(args.events, symbols, accounts, n,
+                                seed=args.seed,
+                                cross_frac=args.cross_frac)
+    lines = [dumps_order(mm) for mm in msgs]
+    split_at = len(lines) // 2
+    pre_sub, router = front.split_lines(lines[:split_at], n,
+                                        prefund=args.prefund)
+    reshard_info = router.reshard(m)
+    post_sub: List[List[str]] = [[] for _ in range(m)]
+    for ln in lines[split_at:]:
+        for g, l2 in router.route_line(ln):
+            post_sub[g].append(l2)
+    sizes_pre = [len(s) for s in pre_sub]
+    sizes_post = [len(s) for s in post_sub]
+    if min(sizes_pre) == 0 or min(sizes_post) == 0:
+        print(f"kme-chaos: substreams pre={sizes_pre} "
+              f"post={sizes_post} — empty group; raise --symbols",
+              file=sys.stderr)
+        return 2
+    old_root = os.path.join(run_dir, "r0")
+    new_root = os.path.join(run_dir, "r1")
+    print(f"kme-chaos: scenario=reshard-under-storm seed={args.seed} "
+          f"{n}->{m} groups split_at={split_at} pre={sizes_pre} "
+          f"post={sizes_post} kill_after_legs={args.reshard_kill_legs}"
+          f"\nkme-chaos: run dir {run_dir}", file=sys.stderr)
+
+    def _serve_cmd(gdir: str, k: int, groups: int, port: int) -> list:
+        serve_args = ["--engine", engine, "--compat", "fixed",
+                      "--batch", str(args.batch),
+                      "--slots", str(args.slots),
+                      "--max-fills", str(args.max_fills),
+                      "--checkpoint-every", str(args.checkpoint_every),
+                      "--checkpoint-keep", str(args.checkpoint_keep),
+                      "--group", f"{k}/{groups}",
+                      "--listen", f"127.0.0.1:{port}",
+                      "--idle-exit", str(args.idle_exit),
+                      "--health-every", "0.1"]
+        return [sys.executable, "-m", "kme_tpu.cli", "supervise",
+                "--checkpoint-dir", gdir,
+                "--stale-after", str(args.stale_after),
+                "--stall-after", str(args.stall_after),
+                "--max-restarts", str(args.max_restarts),
+                "--grace", str(args.grace),
+                "--backoff-base", "0.05", "--backoff-cap", "0.5",
+                "--"] + serve_args
+
+    env = dict(os.environ)
+    env.pop("KME_FAULTS", None)     # the reshard itself is the attack
+    env.pop("KME_FAULTS_STATE", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    # 10 Hz heartbeat sampling across BOTH generations: (wall time,
+    # input offset) — the migration-pause evidence
+    samples: dict = {("old", k): [] for k in range(n)}
+    samples.update({("new", k): [] for k in range(m)})
+    watch = ([("old", k, os.path.join(old_root, f"group{k}"))
+              for k in range(n)]
+             + [("new", k, os.path.join(new_root, f"group{k}"))
+                for k in range(m)])
+    stop_mon = threading.Event()
+
+    def monitor() -> None:
+        while not stop_mon.wait(0.1):
+            for gen, k, gdir in watch:
+                try:
+                    with open(os.path.join(gdir, "serve.health")) as f:
+                        hb = json.load(f)
+                    samples[(gen, k)].append((time.time(),
+                                              int(hb.get("offset", 0))))
+                except (OSError, ValueError, TypeError):
+                    pass
+
+    mon = threading.Thread(target=monitor, daemon=True)
+    mon.start()
+
+    failures: List[str] = []
+    t0 = time.time()
+
+    def _wait_sups(sups: list, deadline: float) -> List[int]:
+        while time.time() < deadline:
+            if all(s.poll() is not None for s in sups):
+                break
+            time.sleep(0.25)
+        for s in sups:
+            if s.poll() is None:
+                print("kme-chaos: TIMEOUT; killing a supervisor",
+                      file=sys.stderr)
+                s.kill()
+                s.wait()
+        return [s.returncode for s in sups]
+
+    # -- phase A: the old generation serves the prefix, then drains ----
+    sups_a, producers = [], []
+    for k in range(n):
+        gdir = os.path.join(old_root, f"group{k}")
+        os.makedirs(gdir, exist_ok=True)
+        port = _free_port()
+        sups_a.append(subprocess.Popen(_serve_cmd(gdir, k, n, port),
+                                       env=env))
+        prod = _Producer("127.0.0.1", port, pre_sub[k],
+                         topic=group_topics(k)[0],
+                         topics=group_topics(k))
+        prod.start()
+        producers.append(prod)
+    rcs_a = _wait_sups(sups_a, t0 + args.timeout)
+    for prod in producers:
+        prod.stop.set()
+        prod.join(timeout=10.0)
+    for k in range(n):
+        if rcs_a[k] != 0:
+            failures.append(f"old group {k} supervisor exited "
+                            f"rc={rcs_a[k]}")
+        if producers[k].sent < sizes_pre[k]:
+            failures.append(f"old group {k} producer delivered "
+                            f"{producers[k].sent} of {sizes_pre[k]}")
+    t_drain = time.time()
+
+    # -- the coordinator: one run SIGKILLed mid-settle, one to done ----
+    coord_cmd = [sys.executable, "-m", "kme_tpu.bridge.reshard",
+                 "--old-root", old_root, "--new-root", new_root,
+                 "--old-groups", str(n), "--new-groups", str(m)]
+    kenv = dict(env)
+    kenv["KME_TEST_HOOKS"] = "1"
+    crash = subprocess.run(
+        coord_cmd + ["--test-kill-after-legs",
+                     str(args.reshard_kill_legs)],
+        env=kenv, capture_output=True, text=True)
+    if crash.returncode != -_signal.SIGKILL:
+        failures.append(f"coordinator mid-settle SIGKILL never fired "
+                        f"(rc={crash.returncode}); the crash-recovery "
+                        f"leg proved nothing")
+    rerun = subprocess.run(coord_cmd, env=env, capture_output=True,
+                           text=True)
+    if rerun.returncode != 0:
+        failures.append(f"coordinator re-run after the crash exited "
+                        f"rc={rerun.returncode}: "
+                        f"{rerun.stderr.strip()[-500:]}")
+    jdoc: dict = {}
+    try:
+        with open(os.path.join(new_root, reshard_mod.JOURNAL)) as f:
+            jdoc = json.load(f)
+    except (OSError, ValueError) as e:
+        failures.append(f"no readable reshard journal: {e}")
+    legs = jdoc.get("migrate", {}).get("legs", [])
+    settle = jdoc.get("settle", {})
+    resume_cursors = settle.get("resume_cursors", [0] * m)
+    if not jdoc.get("done"):
+        failures.append("reshard journal never reached done")
+    if jdoc.get("migrate", {}).get("old_offsets") != sizes_pre:
+        failures.append(
+            f"old generation drained at offsets "
+            f"{jdoc.get('migrate', {}).get('old_offsets')} but the "
+            f"substreams hold {sizes_pre} — the barrier leaked")
+    if crash.returncode == -_signal.SIGKILL \
+            and not settle.get("dup_suppressed"):
+        failures.append("the settle re-run suppressed zero legs — the "
+                        "pre-crash legs were lost, not deduped")
+
+    # -- phase B: the new generation resumes the suffix over TCP ------
+    ports_b = [_free_port() for _ in range(m)]
+    sups_b = []
+    for k in range(m):
+        gdir = os.path.join(new_root, f"group{k}")
+        os.makedirs(gdir, exist_ok=True)
+        sups_b.append(subprocess.Popen(
+            _serve_cmd(gdir, k, m, ports_b[k]), env=env))
+    t_b = time.time()
+    ready_deadline = t_b + args.timeout
+    for k in range(m):
+        ok = False
+        while time.time() < ready_deadline:
+            try:
+                c = TcpBroker("127.0.0.1", ports_b[k], timeout=5.0)
+                provision(c, topics=group_topics(k))   # idempotent
+                c.close()
+                ok = True
+                break
+            except (BrokerError, OSError):
+                time.sleep(0.2)
+        if not ok:
+            failures.append(f"new group {k} broker never came up")
+    links = front.FrontLinks(
+        [f"127.0.0.1:{p}" for p in ports_b],
+        cursors=resume_cursors, retries=40, backoff_s=0.1)
+    fed = [0] * m
+    feed_err: List[str] = []
+    stop_feed = threading.Event()
+
+    def feeder() -> None:
+        # round-robin across the links so the groups drain
+        # concurrently, one stamped produce per sweep per group
+        idx = [0] * m
+        left = sum(sizes_post)
+        while left and not stop_feed.is_set():
+            for g in range(m):
+                if idx[g] >= len(post_sub[g]):
+                    continue
+                try:
+                    links.send(g, post_sub[g][idx[g]])
+                except Exception as e:      # noqa: BLE001 — report all
+                    feed_err.append(f"link {g}: {e}")
+                    return
+                idx[g] += 1
+                fed[g] += 1
+                left -= 1
+
+    fthread = threading.Thread(target=feeder, daemon=True)
+    fthread.start()
+    rcs_b = _wait_sups(sups_b, t_b + args.timeout)
+    stop_feed.set()
+    fthread.join(timeout=10.0)
+    link_state = links.snapshot()
+    links.close()
+    stop_mon.set()
+    mon.join(timeout=2.0)
+    elapsed = time.time() - t0
+    for k in range(m):
+        if rcs_b[k] != 0:
+            failures.append(f"new group {k} supervisor exited "
+                            f"rc={rcs_b[k]}")
+        if fed[k] < sizes_post[k]:
+            failures.append(f"new group {k} front link delivered "
+                            f"{fed[k]} of {sizes_post[k]}")
+    failures.extend(feed_err)
+
+    # -- durable logs: zero dup stamps, then byte parity --------------
+    dup_stamps: dict = {}
+
+    def _merged_actual(root: str, k: int, gen: str) -> List[str]:
+        log_dir = os.path.join(root, f"group{k}", "broker-log")
+        merged = []
+        for topic in (group_topics(k)[1], group_topics(k)[2]):
+            recs = read_matchout_records(log_dir, topic=topic)
+            ring = DedupRing()
+            keep = [r for r in recs
+                    if not ring.is_dup(r.epoch, r.out_seq)]
+            dup_stamps[f"{gen}:{topic}"] = ring.suppressed
+            if ring.suppressed:
+                failures.append(f"{ring.suppressed} duplicate "
+                                f"(epoch,out_seq) stamp(s) in the "
+                                f"{gen}-generation {topic} log")
+            merged.extend(keep)
+        merged.sort(key=lambda r: (r.out_seq
+                                   if r.out_seq is not None else -1))
+        return [f"{r.key} {r.value}" for r in merged]
+
+    actual_pre = [_merged_actual(old_root, k, "old") for k in range(n)]
+    actual_post = [_merged_actual(new_root, k, "new") for k in range(m)]
+    # the new generation's MatchIn carries two stamp kinds on one shared
+    # sequence space: coordinator legs at (epoch 1, 0..legs-1) and front
+    # cursor stamps at (None, legs..) — out_seq alone must be unique
+    for k in range(m):
+        recs = read_matchout_records(
+            os.path.join(new_root, f"group{k}", "broker-log"),
+            topic=group_topics(k)[0])
+        seqs = [r.out_seq for r in recs if r.out_seq is not None]
+        dups = len(seqs) - len(set(seqs))
+        dup_stamps[f"new:{group_topics(k)[0]}"] = dups
+        if dups:
+            failures.append(f"{dups} duplicate out_seq stamp(s) in the "
+                            f"new-generation MatchIn.g{k} log")
+    verify = front.verify_groups_reshard(
+        lines, split_at, actual_pre, actual_post, compat="fixed",
+        book_slots=args.slots, max_fills=args.max_fills,
+        prefund=args.prefund)
+    if not verify["ok"]:
+        failures.append(f"reshard parity FAILED: "
+                        f"{verify['mismatches'][:1]}")
+
+    # -- the settlement ledger: exactly once, despite the SIGKILL -----
+    legs_by_group = collections.Counter(leg[0] for leg in legs)
+    ledger_checks = []
+    for k in range(m):
+        gdir = os.path.join(new_root, f"group{k}")
+        matchin = collections.Counter(
+            r.value for r in read_matchout_records(
+                os.path.join(gdir, "broker-log"),
+                topic=group_topics(k)[0]))
+        for g, _seq, xid, _aid, _amt, leg_line in legs:
+            if g != k:
+                continue
+            got = matchin.get(leg_line, 0)
+            if got != 1:
+                failures.append(f"settlement leg xid={xid} appears "
+                                f"{got}x in MatchIn.g{k} (want exactly "
+                                f"once)")
+        eng, off = ck.load_oracle(gdir)
+        pend = (ck.snapshot_extra(gdir, off).get("pending_reserve", {})
+                if eng is not None else {})
+        front_legs = sum(1 for ln in post_sub[k]
+                         if front.is_internal_line(ln)
+                         and parse_order(ln).action == op.TRANSFER)
+        want_legs = legs_by_group.get(k, 0) + front_legs
+        check = {"group": k, "coordinator_legs": legs_by_group.get(k, 0),
+                 "front_legs": front_legs, "ledger": pend}
+        ledger_checks.append(check)
+        if eng is None:
+            failures.append(f"new group {k} left no final snapshot")
+        elif pend.get("legs") != want_legs or pend.get("rejected"):
+            failures.append(
+                f"new group {k} pending_reserve ledger {pend} != "
+                f"{want_legs} settled legs with zero rejects")
+
+    # -- the old epochs stay dead: durable re-fence probes ------------
+    probes = [reshard_mod.probe_fenced(os.path.join(old_root,
+                                                    f"group{k}"))
+              for k in range(n)]
+    for k, fenced in enumerate(probes):
+        if not fenced:
+            failures.append(f"old group {k} is NOT durably fenced — a "
+                            f"zombie leader could dirty the retired "
+                            f"log")
+
+    # -- bounded dip: migration pause + the new generation's p99 ------
+    first_new = [t for k in range(m)
+                 for t, off in samples[("new", k)] if off >= 1]
+    pause = (min(first_new) - t_drain) if first_new else None
+    if pause is None:
+        failures.append("the new generation never made progress")
+    elif pause > args.reshard_pause:
+        failures.append(f"migration pause {pause:.1f}s over the "
+                        f"{args.reshard_pause}s bound")
+    p99s: dict = {}
+    for gen, count, root in (("old", n, old_root), ("new", m, new_root)):
+        for k in range(count):
+            try:
+                with open(os.path.join(root, f"group{k}",
+                                       "serve.health")) as f:
+                    hb = json.load(f)
+                p99s[f"{gen}:g{k}"] = hb.get("metrics", {}).get(
+                    "latencies", {}).get("lat_e2e", {}).get("p99_ms")
+            except (OSError, ValueError):
+                p99s[f"{gen}:g{k}"] = None
+    for k in range(m):
+        p99 = p99s.get(f"new:g{k}")
+        if p99 is None:
+            failures.append(f"new group {k} left no lat_e2e p99 in its "
+                            f"final heartbeat")
+        elif p99 > args.reshard_p99_ms:
+            # the new generation's histogram includes the settlement
+            # legs, admitted before any leader was up — this bound
+            # covers the migration gap, not just steady-state tail
+            failures.append(f"SLO: new group {k} p99 {p99:.1f}ms over "
+                            f"the {args.reshard_p99_ms}ms bound")
+
+    report = {
+        "ok": not failures,
+        "failures": failures,
+        "scenario": "reshard-under-storm",
+        "seed": args.seed,
+        "events": len(msgs),
+        "old_groups": n,
+        "new_groups": m,
+        "split_at": split_at,
+        "substreams_pre": sizes_pre,
+        "substreams_post": sizes_post,
+        "elapsed_seconds": round(elapsed, 3),
+        "reshard": reshard_info,
+        "plan": jdoc.get("migrate", {}).get("plan"),
+        "settle": {k: settle.get(k) for k in
+                   ("legs", "dup_suppressed", "epochs",
+                    "resume_cursors")},
+        "coordinator_crash_rc": crash.returncode,
+        "duplicate_stamps": dup_stamps,
+        "ledger": ledger_checks,
+        "old_fenced": probes,
+        "migration_pause_s": (round(pause, 3)
+                              if pause is not None else None),
+        "p99_ms": p99s,
+        "front_links": link_state,
+        "verify": dict(verify,
+                       mismatches=verify.get("mismatches", [])[:3]),
+        "run_dir": run_dir,
+    }
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1)
+    status = "OK" if report["ok"] else "FAILED"
+    print(f"kme-chaos: {status} — reshard-under-storm {n}->{m} "
+          f"split_at={split_at} legs={settle.get('legs')} "
+          f"settle_dedup={settle.get('dup_suppressed')} "
+          f"crash_rc={crash.returncode} "
+          f"dup_stamps={sum(dup_stamps.values())} "
+          f"pause={report['migration_pause_s']}s fenced={probes} "
+          f"parity={'byte-exact' if verify['ok'] else 'DIVERGED'} "
+          f"elapsed={elapsed:.1f}s", file=sys.stderr)
+    for fail in failures:
+        print(f"kme-chaos: FAIL: {fail}", file=sys.stderr)
+    print(f"kme-chaos: report written to {report_path}",
+          file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
 def scenario_registry() -> dict:
     """name -> one-line description for every runnable scenario: the
     four recovery drills plus the five adversarial storm profiles
@@ -1016,6 +1465,15 @@ def scenario_registry() -> dict:
                          "live feed subscribers; books byte-exact "
                          "post-promotion, zero dup/missing delta "
                          "seqs",
+        "reshard-under-storm": "live N->M re-split mid-flash-crowd: "
+                               "drain at a batch barrier, fence + "
+                               "migrate + settle (coordinator "
+                               "SIGKILLed mid-settle and re-run), new "
+                               "generation resumes over TCP front "
+                               "links; byte parity across both "
+                               "topologies, zero dup stamps, "
+                               "exactly-once settlement, bounded "
+                               "pause",
     }
     for name, prof in STORM_PROFILES.items():
         reg[name] = (f"storm: {prof.summary} (adaptive overload "
@@ -1348,7 +1806,7 @@ def main(argv=None) -> int:
                         "description) and exit")
     p.add_argument("--scenario",
                    choices=("default", "failover", "shard-failover",
-                            "feed-failover")
+                            "feed-failover", "reshard-under-storm")
                    + tuple(STORM_PROFILES),
                    default="default",
                    help="default = the at-least-once recovery gauntlet "
@@ -1383,6 +1841,28 @@ def main(argv=None) -> int:
                    help="shard-failover scenario: fraction of orders "
                         "placed from non-home accounts (the "
                         "cross-account workload profile)")
+    p.add_argument("--groups-to", type=int, default=4, metavar="M",
+                   help="reshard-under-storm scenario: the new group "
+                        "count the coordinator re-splits to "
+                        "mid-stream")
+    p.add_argument("--reshard-kill-legs", type=int, default=5,
+                   metavar="J",
+                   help="reshard-under-storm scenario: SIGKILL the "
+                        "coordinator after J settlement legs (the "
+                        "crash-during-migration fault; the re-run "
+                        "must dedup)")
+    p.add_argument("--reshard-pause", type=float, default=90.0,
+                   help="reshard-under-storm scenario: bound on the "
+                        "migration pause, old-generation drain -> "
+                        "first new-generation progress (seconds)")
+    p.add_argument("--reshard-p99-ms", type=float, default=10_000.0,
+                   help="reshard-under-storm scenario: bound on the "
+                        "new generation's final lat_e2e p99. The "
+                        "coordinator's settlement legs are admitted "
+                        "while no leader is up, so their e2e latency "
+                        "IS the migration gap — this bounds the "
+                        "user-visible worst case across the re-split, "
+                        "not steady-state tail latency")
     p.add_argument("--max-failover", type=float, default=2.0,
                    help="failover scenario: max seconds from failure "
                         "detection to the promoted replica serving")
@@ -1480,6 +1960,10 @@ def main(argv=None) -> int:
         report_path = args.report or os.path.join(
             run_dir, "chaos-report.json")
         return run_feed_failover(args, run_dir, report_path)
+    if args.scenario == "reshard-under-storm":
+        report_path = args.report or os.path.join(
+            run_dir, "chaos-report.json")
+        return run_reshard_storm(args, run_dir, report_path)
     if args.scenario in STORM_PROFILES:
         report_path = args.report or os.path.join(
             run_dir, "chaos-report.json")

@@ -277,6 +277,7 @@ class GroupRouter:
         # to exact per-order grants.
         self.prefund = max(1, int(prefund))
         self.oid_group: Dict[int, int] = {}    # oid -> routed group
+        self.oid_sid: Dict[int, int] = {}      # oid -> its symbol (reshard)
         self.home: Dict[int, int] = {}         # aid -> home group
         self.cash: Dict[int, int] = {}         # aid -> shadow home cash
         self.reserve: Dict[Tuple[int, int], int] = {}  # (aid, g) -> margin
@@ -317,6 +318,7 @@ class GroupRouter:
         if a in (op.BUY, op.SELL):
             g = symbol_group(msg.sid, n)
             self.oid_group[msg.oid] = g
+            self.oid_sid[msg.oid] = msg.sid
             h = self.account_home(msg.aid)
             out: List[Tuple[int, str]] = []
             w = self._margin_bound(msg) if self.transfers else 0
@@ -393,6 +395,50 @@ class GroupRouter:
             for g, ln in self.route_line(line):
                 per[g].append(ln)
         return per
+
+    def reshard(self, m: int) -> dict:
+        """Re-point this router at an M-group topology IN PLACE,
+        carrying the split state across the boundary (live N→M
+        re-splitting, ROADMAP item 2). Matches what the reshard
+        coordinator does to the engines at the same barrier:
+
+        - resting orders follow their symbol: `oid -> group` remaps via
+          `symbol_group(oid_sid[oid], m)` (a CANCEL for a pre-reshard
+          order must land where the coordinator moved its book);
+        - account custody remaps to `account_group(aid, m)`;
+        - the coordinator consolidates EVERY account's full cash at its
+          new home (bridge/reshard.py settlement legs), so unconsumed
+          reserve residuals parked at old symbol groups fold back into
+          home shadow cash — the shadow stays a conservative lower
+          bound on the new home engine's real balance.
+
+        Deterministic (pure function of prior routing state + m), so a
+        replay of the same prefix + the same reshard barrier regenerates
+        byte-identical post-reshard substreams. Returns a summary of
+        moved keys for reports."""
+        m = max(1, int(m))
+        old_n, moved_oids, moved_homes = self.n, 0, 0
+        for oid, g in list(self.oid_group.items()):
+            sid = self.oid_sid.get(oid)
+            ng = (symbol_group(sid, m) if sid is not None
+                  else group_of(oid, m, SALT_SYMBOL))
+            if ng != g:
+                moved_oids += 1
+            self.oid_group[oid] = ng
+        for aid, h in list(self.home.items()):
+            nh = account_group(aid, m)
+            if nh != h:
+                moved_homes += 1
+            self.home[aid] = nh
+        for (aid, _g), r in self.reserve.items():
+            if r > 0:
+                self.cash[aid] = self.cash.get(aid, 0) + r
+        self.reserve.clear()
+        self.n = m
+        return {"old_groups": old_n, "new_groups": m,
+                "moved_oids": moved_oids, "moved_homes": moved_homes,
+                "tracked_oids": len(self.oid_group),
+                "tracked_accounts": len(self.home)}
 
 
 def split_lines(lines: Iterable[str], ngroups: int,
@@ -482,6 +528,224 @@ def verify_groups(lines: Sequence[str],
     return report
 
 
+def oracle_partition_reshard(lines: Sequence[str], n: int, m: int,
+                             split_at: int, compat: str = "fixed",
+                             book_slots: Optional[int] = None,
+                             max_fills: Optional[int] = None,
+                             transfers: bool = True, prefund: int = 8):
+    """Ground truth for a live N→M reshard at a batch barrier: ONE
+    single-leader oracle processes the whole stream, and its output is
+    partitioned by the routed group of each message — `lines[:split_at]`
+    under the N-topology router, `lines[split_at:]` under the SAME
+    router re-pointed at M groups (`GroupRouter.reshard`, mirroring the
+    coordinator's state migration). Because resharding is pure topology
+    (COMPAT.md), the oracle's wire bytes are untouched; only their
+    group attribution changes. Returns (pre_per_group[n],
+    post_per_group[m], router)."""
+    from kme_tpu.oracle import OracleEngine
+
+    split_at = max(0, min(int(split_at), len(lines)))
+    router = GroupRouter(n, transfers=transfers, prefund=prefund)
+    eng = OracleEngine(compat, book_slots, max_fills)
+    pre: List[List[str]] = [[] for _ in range(max(1, n))]
+    post: List[List[str]] = [[] for _ in range(max(1, m))]
+    for i, line in enumerate(lines):
+        if i == split_at:
+            router.reshard(m)
+        routed = router.route_line(line)
+        prim = [g for g, ln in routed if not is_internal_line(ln)]
+        assert len(prim) == 1, "input line carries the internal marker"
+        dest = pre if i < split_at else post
+        dest[prim[0]].extend(
+            rec.wire() for rec in eng.process(parse_order(line)))
+    if split_at >= len(lines) and router.n != max(1, m):
+        router.reshard(m)
+    return pre, post, router
+
+
+def verify_groups_reshard(lines: Sequence[str], split_at: int,
+                          actual_pre: Sequence[Sequence[str]],
+                          actual_post: Sequence[Sequence[str]],
+                          compat: str = "fixed",
+                          book_slots: Optional[int] = None,
+                          max_fills: Optional[int] = None,
+                          prefund: int = 8) -> dict:
+    """Byte-compare a live N→M reshard run against the partitioned
+    single-leader oracle: `actual_pre[g]` is old-generation group g's
+    raw MatchOut lines (everything it emitted before the barrier
+    drained it), `actual_post[g]` the new generation's. Internal-marked
+    echoes — including the coordinator's settlement legs — are filtered
+    before comparison, exactly like `verify_groups`. report["ok"] is
+    the parity verdict across BOTH generations."""
+    n, m = len(actual_pre), len(actual_post)
+    want_pre, want_post, router = oracle_partition_reshard(
+        lines, n, m, split_at, compat=compat, book_slots=book_slots,
+        max_fills=max_fills, prefund=prefund)
+    report: dict = {"old_groups": n, "new_groups": m,
+                    "split_at": int(split_at), "ok": True,
+                    "mismatches": [],
+                    "counters": dict(router.counters)}
+    for gen, want, actual in (("pre", want_pre, actual_pre),
+                              ("post", want_post, actual_post)):
+        for g in range(len(want)):
+            got = [ln for ln in actual[g] if not is_internal_line(ln)]
+            if got == want[g]:
+                continue
+            report["ok"] = False
+            k = min(len(got), len(want[g]))
+            div = next((i for i in range(k) if got[i] != want[g][i]), k)
+            report["mismatches"].append({
+                "generation": gen, "group": g, "at": div,
+                "got_lines": len(got), "want_lines": len(want[g]),
+                "got": got[div] if div < len(got) else None,
+                "want": want[g][div] if div < len(want[g]) else None})
+    report["merged_lines"] = (len(merge_streams(actual_pre))
+                              + len(merge_streams(actual_post)))
+    report["expected_merged_lines"] = (
+        sum(len(w) for w in want_pre) + sum(len(w) for w in want_post))
+    return report
+
+
+class FrontLinks:
+    """Front-door produce links to per-group `kme-serve` brokers over
+    real TCP (bridge/tcp.py) — the multi-host half of ROADMAP item 2.
+
+    One `TcpBroker` client per group. Link g's produces into its
+    MatchIn topic carry a monotone per-link `out_seq` cursor, which is
+    exactly the broker's idempotent dedup key (PR 4): on a transport
+    fault the client invalidates the connection, reconnects on the next
+    call, and re-sends the SAME stamped record — if the first attempt
+    actually landed before the link died, the durable watermark
+    suppresses the copy. That is reconnect-with-resume off the
+    `(epoch, out_seq)` cursor with zero duplicate records.
+
+    The live front leaves `epoch=None` (a sequence-only stamp): the
+    broker's fence is BROKER-WIDE and owned by the serving leader's
+    lease epoch, so a front-door epoch would either get fenced or —
+    worse — advance the fence under the leader. The reshard
+    coordinator, which runs while no leader is up, is the one caller
+    that passes an epoch (it stamps settlement legs at epoch 1 on the
+    fresh logs, below any future leader's lease). Exactly one stamping
+    front per group topic: the cursor is a per-topic watermark, not a
+    per-producer one."""
+
+    def __init__(self, addrs: Sequence, topic_fmt: str = "MatchIn.g{g}",
+                 epoch: Optional[int] = None, timeout: float = 10.0,
+                 provision: bool = True, retries: int = 8,
+                 backoff_s: float = 0.05,
+                 cursors: Optional[Sequence[int]] = None) -> None:
+        from kme_tpu.bridge.tcp import parse_addr
+
+        self.addrs = [parse_addr(a) if isinstance(a, str)
+                      else (a[0], int(a[1])) for a in addrs]
+        self.n = len(self.addrs)
+        self.topics = [topic_fmt.format(g=g) for g in range(self.n)]
+        self.epoch = epoch
+        self._timeout = timeout
+        self._provision = provision
+        self._retries = max(1, int(retries))
+        self._backoff = backoff_s
+        self.cursor = ([int(c) for c in cursors] if cursors is not None
+                       else [0] * self.n)
+        if len(self.cursor) != self.n:
+            raise ValueError("cursors must match the address count")
+        self._clients: List[Optional[object]] = [None] * self.n
+        self.health = [{"addr": f"{h}:{p}", "topic": self.topics[g],
+                        "connects": 0, "transport_faults": 0,
+                        "produced": 0, "dup_suppressed": 0,
+                        "overload_waits": 0, "last_error": None}
+                       for g, (h, p) in enumerate(self.addrs)]
+
+    def _client(self, g: int):
+        if self._clients[g] is None:
+            from kme_tpu.bridge.broker import BrokerError
+            from kme_tpu.bridge.tcp import TcpBroker
+
+            c = TcpBroker(*self.addrs[g], timeout=self._timeout)
+            if self._provision:
+                try:
+                    c.create_topic(self.topics[g])
+                except BrokerError:
+                    pass    # already provisioned
+            self._clients[g] = c
+            self.health[g]["connects"] += 1
+        return self._clients[g]
+
+    def send(self, g: int, line: str) -> int:
+        """Produce one substream line on link g with the next cursor
+        stamp; retries transport faults and overload pushback with the
+        same stamp. Returns the broker offset (-1 when the dedup
+        watermark swallowed a replayed copy). BrokerFenced propagates —
+        it is a topology verdict, not a link fault."""
+        import time as _time
+
+        from kme_tpu.bridge.broker import (BrokerError, BrokerFenced,
+                                           BrokerOverload)
+
+        h = self.health[g]
+        seq = self.cursor[g]
+        last: Optional[Exception] = None
+        for attempt in range(self._retries):
+            try:
+                off = self._client(g).produce(
+                    self.topics[g], None, line,
+                    epoch=self.epoch, out_seq=seq)
+            except BrokerOverload as e:
+                h["overload_waits"] += 1
+                back = getattr(e, "backoff_ms", None)
+                _time.sleep((back or 50) / 1000.0)
+                last = e
+                continue
+            except BrokerFenced:
+                raise
+            except (BrokerError, OSError) as e:
+                # transport fault (or the serve is still coming up — the
+                # client connects eagerly, so a refused connect surfaces
+                # as a raw OSError): the client invalidates itself and
+                # reconnects on the next call; the retry re-sends the
+                # SAME (epoch, out_seq) record, so an attempt that
+                # landed before the fault dedups instead of duplicating
+                h["transport_faults"] += 1
+                h["last_error"] = str(e)
+                last = e
+                _time.sleep(self._backoff * (attempt + 1))
+                continue
+            self.cursor[g] = seq + 1
+            h["produced"] += 1
+            if off < 0:
+                h["dup_suppressed"] += 1
+            return off
+        raise (last if last is not None else
+               BrokerError(f"link {g}: produce failed"))
+
+    def route(self, router: GroupRouter,
+              line: str) -> List[Tuple[int, int]]:
+        """Split one MatchIn line through `router` and produce every
+        substream record on its group link. Returns [(group, offset)]."""
+        return [(g, self.send(g, ln))
+                for g, ln in router.route_line(line)]
+
+    def end_offsets(self) -> List[int]:
+        """Per-link topic end offsets (drain-barrier probe)."""
+        return [self._client(g).end_offset(self.topics[g])
+                for g in range(self.n)]
+
+    def snapshot(self) -> dict:
+        """Per-link health + cursors, for reports and health files."""
+        return {"groups": self.n, "epoch": self.epoch,
+                "cursors": list(self.cursor),
+                "links": [dict(h) for h in self.health]}
+
+    def close(self) -> None:
+        for c in self._clients:
+            if c is not None:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        self._clients = [None] * self.n
+
+
 def write_front_trace(path: str, lines: Sequence[str], ngroups: int,
                       transfers: bool = True, prefund: int = 8) -> int:
     """Record the front door's own trace spans: one front_accept and
@@ -538,8 +802,13 @@ def main(argv=None) -> int:
                     "MatchOut streams into the canonical global feed, "
                     "or verify an N-group run against the single-leader "
                     "oracle")
-    p.add_argument("mode", choices=("split", "merge", "verify"))
+    p.add_argument("mode", choices=("split", "merge", "verify", "route"))
     p.add_argument("--groups", type=int, required=True, metavar="N")
+    p.add_argument("--brokers", default=None, metavar="H:P,H:P,...",
+                   help="route: comma-separated per-group broker "
+                        "addresses (group k feeds the k-th address over "
+                        "real TCP with reconnect-with-resume off the "
+                        "idempotent out_seq cursor)")
     p.add_argument("--input", default=None, metavar="PATH",
                    help="order-JSONL input stream (default stdin; "
                         "split and verify)")
@@ -595,6 +864,27 @@ def main(argv=None) -> int:
                "per_group": [len(x) for x in per]}
         doc.update(router.counters)
         print(json.dumps(doc), file=sys.stderr)
+        return 0
+    if args.mode == "route":
+        if args.brokers is None:
+            p.error("route needs --brokers")
+        addrs = [a for a in args.brokers.split(",") if a]
+        if len(addrs) != n:
+            p.error(f"--brokers lists {len(addrs)} addresses for "
+                    f"--groups {n}")
+        lines = _read_lines(args.input)
+        router = GroupRouter(n, transfers=not args.no_transfers,
+                             prefund=args.prefund)
+        links = FrontLinks(addrs)
+        try:
+            for line in lines:
+                links.route(router, line)
+        finally:
+            doc = links.snapshot()
+            doc["input_lines"] = len(lines)
+            doc.update(router.counters)
+            print(json.dumps(doc), file=sys.stderr)
+            links.close()
         return 0
     if args.in_dir is None:
         p.error(f"{args.mode} needs --in-dir")

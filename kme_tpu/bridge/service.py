@@ -1486,7 +1486,12 @@ class MatchService:
         # alive — the exact hang shape the supervisor's stall branch
         # exists to catch. The flag file is created before freezing so
         # the restarted incarnation runs clean (stall exactly once).
-        stall_once = os.environ.get("KME_TEST_STALL_ONCE")
+        # Armed ONLY under KME_TEST_HOOKS=1: a stray KME_TEST_STALL_ONCE
+        # in a production environment must never be able to wedge a
+        # real deployment.
+        stall_once = (os.environ.get("KME_TEST_STALL_ONCE")
+                      if os.environ.get("KME_TEST_HOOKS") == "1"
+                      else None)
         stall_at = int(os.environ.get("KME_TEST_STALL_AT", "100"))
 
         seen = 0

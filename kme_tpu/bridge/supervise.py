@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import dataclasses
 import json
 import os
 import random
@@ -433,9 +434,65 @@ def supervise(serve_args, checkpoint_dir: str, stale_after: float = 10.0,
                       echo=echo, stall_after=stall_after, **kw).run()
 
 
+def _autoscale_monitor(state_root: str, groups: int, stop, cfg,
+                       poll: float, echo: bool) -> None:
+    """`--groups auto` policy loop: each tick reads every group's
+    heartbeat, feeds the pure AutoscaleController the `group{k}_lag`
+    and `overload_state` gauges, appends the raw sample to
+    autoscale.trace.jsonl (the replay input for simulate_autoscale)
+    and any proposal to autoscale.json. The supervisor PROPOSES only:
+    executing a proposal is a drain + kme-reshard + restart under the
+    new topology — an operator/drill decision, never a background one
+    (the running serves' topology is immutable by construction)."""
+    from kme_tpu.bridge.autoscale import AutoscaleController
+
+    ctl = AutoscaleController(cfg)
+    dec_path = os.path.join(state_root, "autoscale.json")
+    trace_path = os.path.join(state_root, "autoscale.trace.jsonl")
+
+    def write_decisions() -> None:
+        tmp = dec_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"groups": groups, "ticks": ctl.ticks,
+                       "config": dataclasses.asdict(ctl.cfg),
+                       "decisions": ctl.decisions}, f, indent=1)
+        os.replace(tmp, dec_path)
+
+    with open(trace_path, "a", encoding="utf-8") as trace:
+        while not stop.wait(poll):
+            lags, states = [], []
+            for k in range(groups):
+                lag = state = 0
+                try:
+                    with open(os.path.join(state_root, f"group{k}",
+                                           "serve.health")) as f:
+                        g = json.load(f).get("metrics", {}).get(
+                            "gauges", {})
+                    lag = float(g.get(f"group{k}_lag", 0) or 0)
+                    state = int(g.get("overload_state", 0) or 0)
+                except (OSError, ValueError, TypeError):
+                    pass    # no heartbeat yet: count as idle
+                lags.append(lag)
+                states.append(state)
+            sample = {"groups": groups, "lags": lags,
+                      "overload": states}
+            trace.write(json.dumps(sample) + "\n")
+            trace.flush()
+            d = ctl.observe(groups, lags, states)
+            if d is not None:
+                write_decisions()
+                if echo:
+                    print(f"[autoscale] propose {d['action']} "
+                          f"{d['from']}→{d['to']} (max_lag "
+                          f"{d['max_lag']:.0f}, imbalance "
+                          f"{d['imbalance']})", file=sys.stderr)
+    write_decisions()
+
+
 def supervise_groups(serve_args, state_root: str, groups: int,
                      port_base: int = 9092, host: str = "127.0.0.1",
-                     echo: bool = True, **kw) -> int:
+                     echo: bool = True, autoscale_cfg=None,
+                     autoscale_poll: float = 1.0, **kw) -> int:
     """Multi-leader scale-out (ISSUE 9): run `groups` independent
     leader/standby pairs under ONE supervisor process. Group k gets its
     own checkpoint root <state_root>/group{k} (lease, snapshots, broker
@@ -467,7 +524,16 @@ def supervise_groups(serve_args, state_root: str, groups: int,
             "--listen", f"{host}:{port_base + k}"]
         sups.append(Supervisor(gargs, gdir, echo=echo,
                                tag=f"[g{k}]", **kw))
-    if groups == 1:
+    monitor = stop_monitor = None
+    if autoscale_cfg is not None:
+        stop_monitor = threading.Event()
+        monitor = threading.Thread(
+            target=_autoscale_monitor,
+            args=(state_root, groups, stop_monitor, autoscale_cfg,
+                  autoscale_poll, echo),
+            daemon=True)
+        monitor.start()
+    if groups == 1 and monitor is None:
         return sups[0].run()
     rcs = [0] * groups
     threads = []
@@ -484,6 +550,9 @@ def supervise_groups(serve_args, state_root: str, groups: int,
         threads.append(th)
     for th in threads:
         th.join()
+    if monitor is not None:
+        stop_monitor.set()
+        monitor.join(timeout=10.0)
     return max(rcs)
 
 
@@ -522,13 +591,30 @@ def main(argv=None) -> int:
     p.add_argument("--poll", type=float, default=0.5,
                    help="watch-loop poll interval (failure detection "
                         "latency bound)")
-    p.add_argument("--groups", type=int, default=1, metavar="N",
+    p.add_argument("--groups", default="1", metavar="N|auto",
                    help="multi-leader scale-out: run N independent "
                         "leader(/standby) pairs, group k rooted at "
                         "<checkpoint-dir>/group{k} with --group k/N "
                         "and its own broker port (--port-base + k); "
                         "backoff fingerprints and restart budgets "
-                        "never couple across groups")
+                        "never couple across groups. 'auto' starts "
+                        "--groups-initial groups and runs the "
+                        "deterministic autoscale policy over the group "
+                        "heartbeats, appending split/merge proposals "
+                        "to <checkpoint-dir>/autoscale.json (executed "
+                        "via kme-reshard, never in the background)")
+    p.add_argument("--groups-initial", type=int, default=2, metavar="N",
+                   help="group count '--groups auto' starts with")
+    p.add_argument("--autoscale-high-lag", type=float, default=48.0,
+                   help="per-group input lag that votes split "
+                        "(pairs with kme-serve --overload-high-lag)")
+    p.add_argument("--autoscale-low-lag", type=float, default=4.0,
+                   help="cluster-wide lag ceiling that votes merge")
+    p.add_argument("--autoscale-dwell", type=int, default=3,
+                   help="consecutive hot/cold policy ticks before a "
+                        "proposal (hysteresis)")
+    p.add_argument("--autoscale-cooldown", type=int, default=8,
+                   help="quiet policy ticks after any proposal")
     p.add_argument("--port-base", type=int, default=9092,
                    help="first group's broker port in --groups mode "
                         "(group k listens on --port-base + k)")
@@ -550,12 +636,30 @@ def main(argv=None) -> int:
                   backoff_cap=args.backoff_cap,
                   healthy_decay=args.healthy_decay,
                   standby=args.standby)
+    autoscale_cfg = None
+    if args.groups == "auto":
+        from kme_tpu.bridge.autoscale import AutoscaleConfig
+
+        groups = args.groups_initial
+        autoscale_cfg = AutoscaleConfig(
+            high_lag=args.autoscale_high_lag,
+            low_lag=args.autoscale_low_lag,
+            dwell=args.autoscale_dwell,
+            cooldown=args.autoscale_cooldown)
+    else:
+        try:
+            groups = int(args.groups)
+        except ValueError:
+            p.error(f"--groups wants an integer or 'auto', "
+                    f"got {args.groups!r}")
     try:
-        if args.groups > 1:
+        if groups > 1 or autoscale_cfg is not None:
             return supervise_groups(serve_args, args.checkpoint_dir,
-                                    args.groups,
+                                    groups,
                                     port_base=args.port_base,
-                                    host=args.host, **policy)
+                                    host=args.host,
+                                    autoscale_cfg=autoscale_cfg,
+                                    **policy)
         return supervise(serve_args, args.checkpoint_dir, **policy)
     except ValueError as e:
         print(f"kme-supervise: {e}", file=sys.stderr)

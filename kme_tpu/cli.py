@@ -736,6 +736,17 @@ def front_main(argv=None) -> int:
     return _main(argv)
 
 
+def reshard_main(argv=None) -> int:
+    """Live N->M group re-split over drained leaders: fence the old
+    epochs durably, migrate book/position state through the checkpoint
+    codec, settle balances with stamped exactly-once transfer legs."""
+    try:
+        from kme_tpu.bridge.reshard import main as _main
+    except ImportError:
+        return _not_yet("the reshard coordinator")
+    return _main(argv)
+
+
 def chaos_main(argv=None) -> int:
     """Deterministic fault-injection runs (kme-supervise + KME_FAULTS)
     with byte-exact MatchOut verification against the oracle."""
@@ -759,7 +770,7 @@ def main(argv=None) -> int:
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
         "supervise", "standby", "trace", "chaos", "top", "lint",
-        "front", "agg", "feed"))
+        "front", "agg", "feed", "reshard"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
@@ -770,6 +781,7 @@ def main(argv=None) -> int:
             "trace": trace_main, "chaos": chaos_main,
             "top": top_main, "lint": lint_main, "front": front_main,
             "agg": agg_main, "feed": feed_main,
+            "reshard": reshard_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

@@ -999,12 +999,16 @@ def build_seq_step(cfg: SeqConfig):
                 # tens of ns
                 want = jnp.where(trade_ok, size, _i(0))
 
-                @pl.when(want > _i(0))
-                def _():
-                    vr[0:NR, :] = os_blk
-                    z = jnp.zeros((1, LN), I32)
-                    vr[NR:NR + 1, :] = z
-                    vr[NR + 1:NR + 2, :] = z
+                # init UNCONDITIONALLY per trade message: the post-loop
+                # reads (wsize at the Q2 ghost probe, the merged-book
+                # w_blk select) run for every trade, including a
+                # balance-rejected one (want == 0) — gating this on
+                # `want > 0` would let those reads see the PREVIOUS
+                # message's stale scratch rows
+                vr[0:NR, :] = os_blk
+                z = jnp.zeros((1, LN), I32)
+                vr[NR:NR + 1, :] = z
+                vr[NR + 1:NR + 2, :] = z
 
                 def sweep(c):
                     # SELF-CONTAINED body: every vector it touches is a

@@ -88,6 +88,12 @@ GATED_METRICS = {
     # (weighted message costs, no wall clock, no RNG) — replay-stable,
     # so it gates at zero noise vs BASELINE_shards.json
     "chip_stall_frac": "down",
+    # live resharding (ISSUE r15): fraction of the symbol+account key
+    # universe the N→M reshard plan moves (reshard.plan_reshard) —
+    # pure rendezvous arithmetic, no wall clock, gated at zero noise
+    # vs BASELINE_multihost.json; a consistent-hashing regression
+    # (salt drift, modulo hashing) jumps it toward 1.0
+    "moved_key_frac": "down",
 }
 
 # reported-only: too noisy to gate on (documented flappers).

@@ -172,7 +172,9 @@ def test_supervised_stall_restart_byte_exact(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    # stall exactly once, after ~150 messages (past >= 1 checkpoint)
+    # stall exactly once, after ~150 messages (past >= 1 checkpoint);
+    # the hook only arms under KME_TEST_HOOKS=1 (production safety)
+    env["KME_TEST_HOOKS"] = "1"
     env["KME_TEST_STALL_ONCE"] = str(tmp_path / "stalled.flag")
     env["KME_TEST_STALL_AT"] = "150"
     sup = subprocess.Popen(
