@@ -166,6 +166,34 @@ FEED_SCOPES: Dict[str, Set[str]] = {
         "snapshot_frames"},
 }
 
+# Profiler scopes (ISSUE 16): the continuous-profiling plane is
+# DELIBERATELY outside every table above, and this entry documents the
+# boundary so the exemption is a reviewed decision rather than an
+# accident of omission.
+#
+#  - telemetry/tsdb.py appends, fsyncs, and rotates ON PURPOSE — it is
+#    the durable history store, called only from the 1 Hz heartbeat
+#    thread (serve/standby/feed) or a one-shot CLI exit path, never
+#    from the submit half of the pipeline. Listing it in HOT_SCOPES
+#    would flag its whole reason to exist.
+#  - telemetry/profiler.py reads wall clocks and sleeps ON PURPOSE —
+#    the sampler thread's time.sleep cadence and the capture files'
+#    timestamps are the measurement, not state. Nothing here feeds
+#    replay: TSDB samples are observability output, dedup'd by
+#    sample_seq, and never re-derived on crash-resume, so REPLAY
+#    determinism rules don't apply.
+#
+# The sanctioned coupling points back into scoped code are narrow and
+# already covered: service._publish_batch / _write_heartbeat run on
+# the telemetry thread (not HOT), and the TSDB append in the serve
+# loop is fenced behind `self.tsdb is not None`. If a profiler call
+# ever migrates into a HOT_SCOPES function, the existing hot-scope
+# lint catches it at the call site — no profiler-side rule needed.
+PROFILER_SCOPES: Dict[str, Set[str]] = {
+    "kme_tpu/telemetry/tsdb.py": set(),
+    "kme_tpu/telemetry/profiler.py": set(),
+}
+
 # Tracer scopes: whole directories — everything under them runs (or is
 # staged to run) under jit/vmap/scan/pallas_call.
 TRACED_DIRS = ("kme_tpu/engine/", "kme_tpu/ops/")

@@ -833,6 +833,10 @@ def main(argv=None) -> int:
                    help="capacity envelope for verify (match the "
                         "serving engines' --slots)")
     p.add_argument("--max-fills", type=int, default=None)
+    p.add_argument("--tsdb", default=None, metavar="DIR",
+                   help="route: sample the per-link routing counters "
+                        "into the shared on-disk time-series store "
+                        "every 1000 routed lines (source 'front')")
     p.add_argument("--prefund", type=int, default=8,
                    help="orders' worth of worst-case margin granted "
                         "per reserve->settle transfer pair (residual "
@@ -876,13 +880,52 @@ def main(argv=None) -> int:
         router = GroupRouter(n, transfers=not args.no_transfers,
                              prefund=args.prefund)
         links = FrontLinks(addrs)
+        tsdb = None
+        tsdb_seq = 0
+        if args.tsdb is not None:
+            from kme_tpu.telemetry import TSDB
+
+            try:
+                tsdb = TSDB(args.tsdb, source="front")
+                tsdb_seq = tsdb.next_seq()
+            except (OSError, ValueError) as e:
+                print(f"kme-front: TSDB disabled: {e}",
+                      file=sys.stderr)
+
+        def _tsdb_sample(routed):
+            nonlocal tsdb, tsdb_seq
+            if tsdb is None:
+                return
+            snap = links.snapshot()
+            vals = {"front_routed_lines_total": routed,
+                    "front_epoch": snap["epoch"]}
+            for g, cur in enumerate(snap["cursors"]):
+                vals[f"front_cursor.g{g}"] = cur
+            for g, h in enumerate(snap["links"]):
+                for hk, hv in h.items():
+                    if isinstance(hv, (int, float)) \
+                            and not isinstance(hv, bool):
+                        vals[f"front_{hk}.g{g}"] = hv
+            try:
+                tsdb.append_values(vals, tsdb_seq)
+                tsdb_seq += 1
+            except OSError:
+                tsdb = None     # history is best-effort
         try:
-            for line in lines:
+            for i, line in enumerate(lines):
                 links.route(router, line)
+                if (i + 1) % 1000 == 0:
+                    # the front door is a batch process, not a serve
+                    # loop: history samples ride routing progress
+                    # instead of wall-clock heartbeats
+                    _tsdb_sample(i + 1)
         finally:
             doc = links.snapshot()
             doc["input_lines"] = len(lines)
             doc.update(router.counters)
+            _tsdb_sample(len(lines))
+            if tsdb is not None:
+                tsdb.close()
             print(json.dumps(doc), file=sys.stderr)
             links.close()
         return 0

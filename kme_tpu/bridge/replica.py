@@ -148,7 +148,8 @@ class Replica:
                  idle_exit: Optional[float] = None,
                  metrics_port: Optional[int] = None,
                  group=None, journal_out: Optional[str] = None,
-                 trace_spans: bool = False) -> None:
+                 trace_spans: bool = False,
+                 tsdb: Optional[str] = None) -> None:
         self.group = group
         # armed at PROMOTION only: a follower's output is discarded, so
         # journaling its stages would double-record every offset the
@@ -185,6 +186,24 @@ class Replica:
             checkpoint_every=checkpoint_every,
             checkpoint_keep=checkpoint_keep,
             exactly_once=True, follower=True, group=group)
+        self.tsdb = None
+        self._tsdb_dir = tsdb
+        if tsdb is not None:
+            # the standby writes its own per-source history next to the
+            # leader's in the shared TSDB dir; no checkpoint carries a
+            # follower's sample cursor, so it adopts the store's
+            # next_seq (replays after a standby restart would otherwise
+            # dedup against its own history forever)
+            from kme_tpu.telemetry import TSDB
+            source = "standby"
+            if group is not None and group[1] > 1:
+                source = f"standby.g{group[0]}"
+            try:
+                self.tsdb = TSDB(tsdb, source=source)
+                self._tsdb_seq = self.tsdb.next_seq()
+            except (OSError, ValueError) as e:
+                print(f"kme-standby: TSDB disabled: {e}",
+                      file=sys.stderr)
         self.metrics_server = None
         if metrics_port is not None:
             # the standby's own metrics surface (kme-top scrapes it
@@ -228,6 +247,14 @@ class Replica:
         return 0
 
     def _write_heartbeat(self, applied: int, tick: int) -> None:
+        snap = self.svc.telemetry.snapshot()
+        if self.tsdb is not None:
+            try:
+                seq = self._tsdb_seq
+                self._tsdb_seq = seq + 1
+                self.tsdb.append_snapshot(snap, seq)
+            except OSError:
+                self.tsdb = None    # history is best-effort
         if self.health_file is None:
             return
         tmp = self.health_file + ".tmp"
@@ -239,7 +266,7 @@ class Replica:
                            "out_seq": self.svc.out_seq,
                            "discarded": self.follow.discarded,
                            "leader_offset": self._leader_offset(),
-                           "metrics": self.svc.telemetry.snapshot()}, f)
+                           "metrics": snap}, f)
             os.replace(tmp, self.health_file)
         except OSError:
             pass        # reporting surface only
@@ -294,6 +321,15 @@ class Replica:
         from kme_tpu.bridge.tcp import parse_addr, serve_broker
 
         svc = self.svc
+        if self.tsdb is not None:
+            # hand history over to the serve path: the promoted leader
+            # continues the LEADER's source series (adopting its
+            # next_seq cursor from disk), not the standby's
+            self.tsdb.close()
+            self.tsdb = None
+            svc._tsdb_arg = self._tsdb_dir
+            svc.follower = False    # source name resolves to "serve"
+            svc._init_profiling(resumed=False)
         with contextlib.suppress(OSError):
             os.unlink(self.promote_file)
         broker = InProcessBroker(persist_dir=self.log_dir,
@@ -419,6 +455,12 @@ def main(argv=None) -> int:
                    help="armed at PROMOTION: continue the leader's "
                         "per-order span stream (requires "
                         "--journal-out)")
+    p.add_argument("--tsdb", default=None, metavar="DIR",
+                   help="append this standby's heartbeat metrics to "
+                        "the shared on-disk time-series store (source "
+                        "'standby'); at promotion the store is handed "
+                        "to the serve path and history continues under "
+                        "the leader's source")
     args, unknown = p.parse_known_args(argv)
     if unknown:
         # the supervisor forwards the leader's serve_args verbatim;
@@ -452,7 +494,7 @@ def main(argv=None) -> int:
                   idle_exit=args.idle_exit,
                   metrics_port=args.metrics_port,
                   group=group, journal_out=args.journal_out,
-                  trace_spans=args.trace_spans)
+                  trace_spans=args.trace_spans, tsdb=args.tsdb)
     try:
         return rep.run()
     except BrokerFenced as e:
@@ -461,6 +503,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         return 0
     finally:
+        if rep.tsdb is not None:
+            rep.tsdb.close()
         if rep.metrics_server is not None:
             rep.metrics_server.shutdown()
 

@@ -196,6 +196,30 @@ def main(argv=None) -> int:
                         "a per-group --checkpoint-dir so the lease/"
                         "journal/snapshot roots are disjoint (kme-"
                         "supervise --groups N wires all of this)")
+    p.add_argument("--tsdb", default=None, metavar="DIR",
+                   help="append every heartbeat's metrics snapshot to "
+                        "an on-disk time-series store in DIR (kme-prof "
+                        "queries it); samples carry a monotonic "
+                        "sample_seq persisted with the checkpoint so a "
+                        "crash-resume dedups replayed heartbeats")
+    p.add_argument("--profile", action="store_true",
+                   help="always-on host sampling profiler: attributes "
+                        "serve-loop wall time to pipeline stages "
+                        "(parse/plan/dispatch/collect/produce) as "
+                        "prof_stage_frac_* gauges")
+    p.add_argument("--profile-artifact", default=None, metavar="PATH",
+                   help="on close, write the per-backend transfer-vs-"
+                        "compute JSON artifact (XLA cost_analysis + "
+                        "measured H2D bandwidth) merged in place by "
+                        "backend key")
+    p.add_argument("--capture-dir", default=None, metavar="DIR",
+                   help="trigger-based capture: on SLO burn or a p99 "
+                        "exemplar past --capture-p99-us, record a "
+                        "bounded profile window to DIR (span ids "
+                        "resolve through kme-trace)")
+    p.add_argument("--capture-p99-us", type=int, default=None,
+                   metavar="US", help="exemplar e2e threshold that "
+                        "fires a capture even without SLO burn")
     p.add_argument("--annotate-rejects", action="store_true",
                    help="emit an ADDITIVE 'REJ'-keyed MatchOut record "
                         "naming each rejected order's rej_* reason "
@@ -295,6 +319,11 @@ def main(argv=None) -> int:
                        pipeline=args.pipeline,
                        group=group,
                        trace_spans=args.trace_spans,
+                       tsdb=args.tsdb,
+                       profile=args.profile,
+                       profile_artifact=args.profile_artifact,
+                       capture_dir=args.capture_dir,
+                       capture_p99_us=args.capture_p99_us,
                        slo=(None if args.slo_p99_ms is None else {
                            "stage": args.slo_stage,
                            "p99_ms": args.slo_p99_ms,

@@ -68,7 +68,12 @@ class MatchService:
                  pipeline: int = 0,
                  group=None,
                  slo=None,
-                 trace_spans: bool = False) -> None:
+                 trace_spans: bool = False,
+                 tsdb: Optional[str] = None,
+                 profile: bool = False,
+                 profile_artifact: Optional[str] = None,
+                 capture_dir: Optional[str] = None,
+                 capture_p99_us: Optional[int] = None) -> None:
         if engine not in ("lanes", "seq", "oracle", "native"):
             raise ValueError(f"unknown engine {engine!r}")
         if compat not in ("java", "fixed"):
@@ -185,6 +190,22 @@ class MatchService:
         # "span" events keyed by local_tid(group, broker offset) — the
         # stitcher joins them to the front's global trace ids offline
         self.trace_spans = bool(trace_spans)
+        # continuous profiling & history (ISSUE 16): metrics history on
+        # disk at heartbeat cadence, the sampling host profiler, the
+        # per-backend transfer/compute artifact, trigger captures
+        self._tsdb_arg = tsdb
+        self._profile_arg = bool(profile)
+        self._profile_artifact = profile_artifact
+        self._capture_dir = capture_dir
+        self._capture_p99_us = capture_p99_us
+        self.tsdb = None
+        self.profiler = None
+        self.capture = None
+        # monotonic heartbeat-sample sequence: persisted across restart
+        # via the checkpoint's additive `extra` meta so TSDB ingestion
+        # dedups replayed samples exactly like the broker dedups
+        # (epoch, out_seq) produce stamps
+        self.sample_seq = 0
         self._slo_arg = slo         # dict of SLO kwargs, or None
         self.slo = None
         self._slo_reason = None
@@ -205,6 +226,7 @@ class MatchService:
         if checkpoint_dir is not None:
             resumed = self._try_resume(engine, compat, shards, width)
         if resumed:
+            self._restore_sample_seq()
             self._init_exactly_once(resumed=True)
             self._init_telemetry()
             self._init_observability(resumed=True)
@@ -239,6 +261,20 @@ class MatchService:
         self._init_telemetry()
         self._init_observability(resumed=False)
         self._commit_watermark()
+
+    def _restore_sample_seq(self) -> None:
+        """Heartbeat sample_seq continuation across a resume — read
+        from the snapshot's additive extra meta REGARDLESS of the
+        exactly-once setting (metrics history is not an exactly-once
+        feature; any checkpointed service keeps a continuous TSDB
+        sequence)."""
+        from kme_tpu.runtime import checkpoint as ck
+
+        extra = ck.snapshot_extra(self.checkpoint_dir, self.offset)
+        try:
+            self.sample_seq = max(0, int(extra.get("sample_seq", 0)))
+        except (TypeError, ValueError):
+            self.sample_seq = 0
 
     def _init_exactly_once(self, resumed: bool) -> None:
         """Exactly-once startup: restore the produce-stamp cursor from
@@ -345,6 +381,7 @@ class MatchService:
         self.journal = j
         if j is not None and resumed:
             j.rewind_to_offset(self.offset)
+        self._init_profiling(resumed)
         if not self._audit_arg:
             return
         if self._compat != "fixed":
@@ -386,10 +423,61 @@ class MatchService:
             self.auditor.tamper = tamper
         j.observers.append(self.auditor.observe)
 
+    def _init_profiling(self, resumed: bool) -> None:
+        """Continuous profiling & history wiring (ISSUE 16): the TSDB
+        heartbeat feed, the sampling host profiler, and the SLO/p99
+        trigger capture. All additive: a failure to open the history
+        store degrades the observability surface, never the engine."""
+        if self._tsdb_arg is not None:
+            from kme_tpu.telemetry.tsdb import TSDB
+
+            source = ("follower" if self.follower else "serve")
+            if self.group_count > 1:
+                source = f"{source}.g{self.group_id}"
+            try:
+                self.tsdb = TSDB(self._tsdb_arg, source=source)
+            except (OSError, ValueError) as e:
+                print(f"kme-serve: TSDB disabled ({e})", file=sys.stderr)
+            if self.tsdb is not None and not resumed:
+                # no checkpoint cursor to continue: adopt the store's
+                # high-water mark so a plain restart keeps appending
+                # instead of deduping against its own history
+                self.sample_seq = max(self.sample_seq,
+                                      self.tsdb.next_seq())
+        if self._profile_arg:
+            from kme_tpu.telemetry.profiler import StageProfiler
+
+            self.profiler = StageProfiler(registry=self.telemetry)
+            self.profiler.start()
+        if self._capture_dir is not None:
+            from kme_tpu.telemetry.profiler import TriggerCapture
+
+            self.capture = TriggerCapture(
+                self._capture_dir, p99_us=self._capture_p99_us,
+                registry=self.telemetry)
+
     def close(self) -> None:
         """Flush + close the flight recorder (serve shutdown path)."""
         if getattr(self, "_pipe", None):
             self._drain_pipeline()
+        if getattr(self, "profiler", None) is not None:
+            self.profiler.stop()
+        if getattr(self, "_profile_artifact", None) is not None:
+            from kme_tpu.telemetry.profiler import (device_plane,
+                                                    write_transfer_artifact)
+
+            try:
+                # a session-less engine (oracle) still records the
+                # host plane: backend + measured H2D bandwidth
+                plane = device_plane(session=self._session)
+                write_transfer_artifact(self._profile_artifact, plane)
+                print(f"kme-serve: transfer/compute artifact written to "
+                      f"{self._profile_artifact}", file=sys.stderr)
+            except (OSError, ValueError) as e:
+                print(f"kme-serve: transfer artifact failed ({e})",
+                      file=sys.stderr)
+        if getattr(self, "tsdb", None) is not None:
+            self.tsdb.close()
         if getattr(self, "journal", None) is not None:
             self.journal.close()
 
@@ -690,7 +778,10 @@ class MatchService:
                 print(f"kme-serve: broker sync failed before checkpoint "
                       f"({e}); snapshot deferred", file=sys.stderr)
                 return
-        extra = None
+        # the heartbeat sample cursor rides EVERY snapshot (not just
+        # exactly-once leaders'): a resumed service continues the TSDB
+        # sequence so replayed heartbeat samples dedup on ingestion
+        extra = {"sample_seq": self.sample_seq}
         if self.epoch is not None:
             from kme_tpu.bridge import lease
             from kme_tpu.bridge.broker import BrokerFenced
@@ -713,7 +804,7 @@ class MatchService:
                 raise BrokerFenced(
                     f"fenced: leader epoch {self.epoch} superseded by "
                     f"{cur}; refusing to checkpoint")
-            extra = {"epoch": self.epoch, "out_seq": self.out_seq}
+            extra.update(epoch=self.epoch, out_seq=self.out_seq)
             if self.topic_xfer is not None:
                 # the pending_reserve ledger rides the snapshot so a
                 # resumed leader reports continuous cross-shard totals;
@@ -1219,6 +1310,17 @@ class MatchService:
                 # SLO degradation rides the same heartbeat channel as
                 # an audit violation; the auditor's verdict wins
                 self._slo_reason = self.slo.evaluate()
+            if self.profiler is not None:
+                self.profiler.publish(t)
+            if self.capture is not None:
+                # trigger-based capture: SLO burn or a p99 exemplar
+                # past threshold records a bounded profile window whose
+                # span ids resolve through kme-trace
+                fired = self.capture.maybe_fire(self._slo_reason,
+                                                t.exemplars())
+                if fired:
+                    print(f"kme-serve: profile capture {fired}",
+                          file=sys.stderr)
 
     def _publish_eos_gauges(self) -> None:
         """Exactly-once observability (cheap broker-attribute reads;
@@ -1496,7 +1598,10 @@ class MatchService:
 
         seen = 0
         beat_stop = None
-        if health_file is not None:
+        # the beater thread also runs when only a TSDB is configured
+        # (health_file=None): metrics history wants the same heartbeat
+        # cadence whether or not a supervisor is watching
+        if health_file is not None or self.tsdb is not None:
             beat_stop = threading.Event()
             state = self
 
@@ -1528,7 +1633,7 @@ class MatchService:
                 else:
                     idle_since = now
                     seen += n
-                    if health_file is not None:
+                    if beat_stop is not None:
                         seen_box[0] = seen
                 if (stall_once and seen >= stall_at
                         and not os.path.exists(stall_once)):
@@ -1559,7 +1664,7 @@ class MatchService:
                                           tick_box[0], closing=True)
         return seen
 
-    def _write_heartbeat(self, path: str, seen: int,
+    def _write_heartbeat(self, path: Optional[str], seen: int,
                          tick: int = 0, closing: bool = False) -> None:
         import json
         import os
@@ -1569,6 +1674,17 @@ class MatchService:
         # the batch path: the final heartbeat after run() drains must
         # capture post-batch suppressions/fences
         self._publish_eos_gauges()
+        # one monotonically increasing id per heartbeat: the TSDB uses
+        # it to dedup samples replayed after a crash-resume exactly the
+        # way the broker dedups (epoch, out_seq); persisted via
+        # checkpoint extra so a resumed service keeps counting from
+        # where the snapshot left off
+        seq = self.sample_seq
+        self.sample_seq = seq + 1
+        snap = self.telemetry.snapshot()
+        if path is None:       # TSDB-only heartbeat (no supervisor)
+            self._append_tsdb(snap, seq)
+            return
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             # "metrics" is ADDITIVE — the supervisor keys
@@ -1585,5 +1701,18 @@ class MatchService:
                        "degraded": self.degraded or self._slo_reason,
                        "role": "follower" if self.follower else "leader",
                        "epoch": self.epoch,
-                       "metrics": self.telemetry.snapshot()}, f)
+                       "sample_seq": seq,
+                       "metrics": snap}, f)
         os.replace(tmp, path)
+        self._append_tsdb(snap, seq)
+
+    def _append_tsdb(self, snap: dict, seq: int) -> None:
+        if self.tsdb is None:
+            return
+        try:
+            self.tsdb.append_snapshot(snap, seq)
+        except OSError as e:
+            # history is best-effort; the live heartbeat is not
+            print(f"kme-serve: TSDB append failed: {e}",
+                  file=sys.stderr)
+            self.tsdb = None

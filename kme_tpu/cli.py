@@ -52,6 +52,10 @@ def loadgen_main(argv=None) -> int:
     p.add_argument("--report", default=None, metavar="PATH",
                    help="write a JSON run report (throughput, AIMD "
                         "rates, observed backoff_ms decay)")
+    p.add_argument("--tsdb-out", default=None, metavar="DIR",
+                   help="append a final client-side sample (produced, "
+                        "rate, sheds, worst RTT) to the shared on-disk "
+                        "time-series store (source 'loadgen')")
     p.add_argument("--trace-sample", type=int, default=10, metavar="N",
                    help="--connections mode: keep the N slowest sends "
                         "by RTT in the report, each with the "
@@ -109,10 +113,31 @@ def loadgen_main(argv=None) -> int:
         note = f" ({shed} overload backoffs)" if shed else ""
         print(f"kme-loadgen: produced {len(msgs)} records to MatchIn"
               f"{note}", file=sys.stderr)
+        _tsdb_append_once(args.tsdb_out, "loadgen",
+                          {"loadgen_produced_total": len(msgs),
+                           "loadgen_sheds_total": shed},
+                          "kme-loadgen")
         return 0
     for m in msgs:
         print(dumps_order(m))
     return 0
+
+
+def _tsdb_append_once(store, source: str, vals: dict,
+                      tool: str) -> None:
+    """One-shot client-side history sample (kme-loadgen): open the
+    shared store, adopt its cursor, append, close. Best-effort — a
+    client must never die because the history disk filled."""
+    if store is None:
+        return
+    from kme_tpu.telemetry import TSDB
+
+    try:
+        db = TSDB(store, source=source)
+        db.append_values(vals, db.next_seq())
+        db.close()
+    except (OSError, ValueError) as e:
+        print(f"{tool}: TSDB write failed: {e}", file=sys.stderr)
 
 
 def _loadgen_connections(args, msgs) -> int:
@@ -296,6 +321,17 @@ def _loadgen_connections(args, msgs) -> int:
     if args.report:
         with open(args.report, "w") as f:
             _json.dump(report, f, indent=1)
+    vals = {"loadgen_produced_total": int(next_seq),
+            "loadgen_sheds_total": int(sheds),
+            "loadgen_dup_suppressed_total": int(dup),
+            "loadgen_transport_retries_total": int(transport_retries)}
+    if report["rate_rps"] is not None:
+        vals["loadgen_rate_rps"] = report["rate_rps"]
+    if slow:
+        vals["loadgen_slowest_rtt_us"] = slow[0]["rtt_us"]
+    if report["backoff_ms_last"] is not None:
+        vals["loadgen_backoff_ms_last"] = report["backoff_ms_last"]
+    _tsdb_append_once(args.tsdb_out, "loadgen", vals, "kme-loadgen")
     print(f"kme-loadgen: {next_seq} records from {ncli} simulated "
           f"clients ({'binary' if args.binary else 'json'}) in "
           f"{dur:.2f}s, {sheds} sheds, {transport_retries} transport "
@@ -517,6 +553,11 @@ def agg_main(argv=None) -> int:
                    help="emit the full aggregate document as JSON")
     p.add_argument("--out", default=None, metavar="PATH",
                    help="also write the aggregate JSON here")
+    p.add_argument("--history", default=None, metavar="DIR",
+                   help="on-disk TSDB store (kme-serve --tsdb et al.): "
+                        "append per-source history — sparkline "
+                        "look-back in the text view, window summaries "
+                        "under a 'history' key in --json/--out")
     args = p.parse_args(argv)
     import json
 
@@ -542,6 +583,22 @@ def agg_main(argv=None) -> int:
         snaps.append((src, node["metrics"] if node["ok"] else None))
     doc = dtrace.aggregate(snaps, slo_ms=args.slo_ms,
                            slo_target=args.slo_target)
+    hist_sources = []
+    if args.history:
+        import os as _os
+
+        from kme_tpu.telemetry import tsdb as _tsdb
+
+        try:
+            hist_sources = sorted(
+                {e[:-len(".kmet")] for e in _os.listdir(args.history)
+                 if e.endswith(".kmet")})
+        except OSError as e:
+            print(f"kme-agg: history store unreadable: {e}",
+                  file=sys.stderr)
+        doc["history"] = {
+            src: _tsdb.window_summary(args.history, source=src)
+            for src in hist_sources}
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=1)
@@ -549,7 +606,129 @@ def agg_main(argv=None) -> int:
         print(json.dumps(doc, indent=1))
     else:
         print(dtrace.render_agg(doc))
+        if hist_sources:
+            from kme_tpu.telemetry.top import history_lines
+
+            for src in hist_sources:
+                for ln in history_lines(args.history, source=src):
+                    print(ln)
     return 0 if any(s for _n, s in snaps) else 1
+
+
+def prof_main(argv=None) -> int:
+    """Profiling & telemetry-history query tool over the on-disk TSDB
+    (kme-serve --tsdb and friends): list/plot/export metric series,
+    verify segment digests, inspect the transfer-vs-compute artifact,
+    and attribute a regression to a pipeline stage with --diff between
+    two history windows or recorded BENCH artifacts."""
+    p = argparse.ArgumentParser(prog="kme-prof",
+                                description=prof_main.__doc__)
+    p.add_argument("store", nargs="?", default=None, metavar="DIR",
+                   help="TSDB store directory (or one .kmet segment)")
+    p.add_argument("--source", default=None, metavar="NAME",
+                   help="only this writer's series (serve, standby, "
+                        "feed, front, consume, loadgen, ...; default "
+                        "all)")
+    p.add_argument("--names", default=None, metavar="A,B,...",
+                   help="only these series (exact names, comma-"
+                        "separated)")
+    p.add_argument("--last", type=int, default=None, metavar="N",
+                   help="keep only the newest N points per series")
+    p.add_argument("--csv", action="store_true",
+                   help="emit ts_us,source-agnostic CSV rows instead "
+                        "of the sparkline table")
+    p.add_argument("--json", action="store_true",
+                   help="emit machine-readable JSON")
+    p.add_argument("--verify", action="store_true",
+                   help="audit the sha256 sidecars of every finalized "
+                        "segment (exit 1 on any mismatch)")
+    p.add_argument("--artifact", default=None, metavar="PATH",
+                   help="print the per-backend transfer-vs-compute "
+                        "artifact (kme-serve --profile-artifact) "
+                        "instead of querying a store")
+    p.add_argument("--diff", nargs=2, default=None,
+                   metavar=("BASE", "CUR"),
+                   help="stage-level regression attribution between "
+                        "two TSDB stores (window summaries) or two "
+                        "recorded BENCH/driver artifacts — each "
+                        "operand may be either")
+    args = p.parse_args(argv)
+    import json
+    import os
+
+    from kme_tpu.telemetry import tsdb
+
+    if args.artifact is not None:
+        from kme_tpu.telemetry import read_transfer_artifact
+
+        try:
+            doc = read_transfer_artifact(args.artifact)
+        except (OSError, ValueError) as e:
+            print(f"kme-prof: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 0
+    if args.diff is not None:
+        from kme_tpu import perfgate
+
+        def _metrics(operand: str):
+            if os.path.isdir(operand):
+                return tsdb.window_summary(operand,
+                                           source=args.source)
+            return perfgate.load_artifact(operand)["metrics"]
+
+        base, cur = (_metrics(x) for x in args.diff)
+        if not base or not cur:
+            print("kme-prof: no metrics on one side of --diff",
+                  file=sys.stderr)
+            return 2
+        att = perfgate.attribute_regression(base, cur)
+        if args.json:
+            print(json.dumps(att, indent=1))
+        else:
+            print(perfgate.format_attribution(att))
+        return 0
+    if args.store is None:
+        p.error("give a store dir (or --artifact / --diff)")
+    if args.verify:
+        rep = tsdb.verify_store(args.store)
+        print(json.dumps(rep) if args.json else
+              f"kme-prof: {rep['verified']}/{rep['segments']} "
+              f"segment digests verified"
+              + (f"; MISMATCHED: {', '.join(rep['mismatched'])}"
+                 if rep["mismatched"] else ""))
+        return 1 if rep["mismatched"] else 0
+    names = ([n for n in args.names.split(",") if n]
+             if args.names else None)
+    series = tsdb.query(args.store, names=names, source=args.source)
+    if args.last:
+        series = {k: v[-args.last:] for k, v in series.items()}
+    if not series:
+        print("kme-prof: no samples matched", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({k: [[ts, v] for ts, v in pts]
+                          for k, pts in series.items()},
+                         sort_keys=True))
+        return 0
+    if args.csv:
+        print("name,ts_us,value")
+        for name in sorted(series):
+            for ts, v in series[name]:
+                print(f"{name},{ts},{v:g}")
+        return 0
+    from kme_tpu.telemetry.top import sparkline
+
+    w = max(len(n) for n in series)
+    for name in sorted(series):
+        pts = series[name]
+        vals = [v for _ts, v in pts]
+        shown = vals
+        if tsdb._is_monotonic_name(name) and len(vals) > 1:
+            shown = [b - a for a, b in zip(vals, vals[1:])]
+        print(f"{name:<{w}s}  n={len(pts):<6d} "
+              f"{sparkline(shown):<24s} last={vals[-1]:g}")
+    return 0
 
 
 def trace_main(argv=None) -> int:
@@ -770,7 +949,7 @@ def main(argv=None) -> int:
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
         "supervise", "standby", "trace", "chaos", "top", "lint",
-        "front", "agg", "feed", "reshard"))
+        "front", "agg", "feed", "reshard", "prof"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
@@ -781,7 +960,7 @@ def main(argv=None) -> int:
             "trace": trace_main, "chaos": chaos_main,
             "top": top_main, "lint": lint_main, "front": front_main,
             "agg": agg_main, "feed": feed_main,
-            "reshard": reshard_main,
+            "reshard": reshard_main, "prof": prof_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

@@ -1591,6 +1591,41 @@ def build_seq_scan(cfg: SeqConfig, k: int):
     return jax.jit(call_scan)
 
 
+def step_cost_analysis(cfg: SeqConfig, k: int = 4):
+    """Compiled-scan cost model for the profiler's device plane
+    (telemetry/profiler.py): lower + compile a k-chunk NOP batch and
+    read XLA's `cost_analysis()` — flops and bytes touched per
+    dispatch, normalized to {"flops", "bytes_accessed"}. The lowering
+    hits the same jit cache the serving path warms, so calling this on
+    a live session costs one metadata read, not a recompile. Returns
+    None when the backend exposes no cost model (never raises — the
+    profiler degrades, the engine does not)."""
+    try:
+        state = make_seq_state(cfg)
+        cols = {name: np.zeros(cfg.batch, np.int64)
+                for name in ("act", "aid", "price", "size", "lane",
+                             "oid", "aid_raw", "sid_raw", "flags")}
+        one = pack_msgs(cfg, cols, 0)
+        stacked = {name: np.broadcast_to(
+            v, (k,) + v.shape).copy() for name, v in one.items()}
+        compiled = build_seq_scan(cfg, k).lower(state, stacked).compile()
+        ca = compiled.cost_analysis()
+    except Exception:   # noqa: BLE001 — cost probe only, never fatal
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = ca.get("flops")
+    nbytes = ca.get("bytes accessed", ca.get("bytes_accessed"))
+    out = {}
+    if isinstance(flops, (int, float)) and flops > 0:
+        out["flops"] = float(flops)
+    if isinstance(nbytes, (int, float)) and nbytes > 0:
+        out["bytes_accessed"] = float(nbytes)
+    return out or None
+
+
 # ---------------------------------------------------------------------------
 # host-side packing / unpacking
 

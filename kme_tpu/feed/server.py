@@ -475,6 +475,11 @@ def main(argv=None) -> int:
                    help="feed snapshot directory (cold-start resume)")
     p.add_argument("--snapshot-every", type=int, default=0,
                    metavar="RECORDS")
+    p.add_argument("--tsdb", default=None, metavar="DIR",
+                   help="append the fan-out metrics snapshot to the "
+                        "shared on-disk time-series store every "
+                        "heartbeat (source 'feed'; kme-prof queries "
+                        "it)")
     args = p.parse_args(argv)
     from kme_tpu.bridge.tcp import TcpBroker, parse_addr
 
@@ -503,21 +508,42 @@ def main(argv=None) -> int:
     if args.state_root:
         os.makedirs(args.state_root, exist_ok=True)
         health = os.path.join(args.state_root, "feed.health")
+    tsdb = None
+    tsdb_seq = 0
+    if args.tsdb is not None:
+        from kme_tpu.telemetry import TSDB
+
+        source = f"feed.g{k}" if n > 1 else "feed"
+        try:
+            tsdb = TSDB(args.tsdb, source=source)
+            tsdb_seq = tsdb.next_seq()  # no durable cursor: adopt disk
+        except (OSError, ValueError) as e:
+            print(f"kme-feed: TSDB disabled: {e}", file=sys.stderr)
     print(f"kme-feed: group {k} serving {topic} on "
           f"{server.address[0]}:{server.address[1]}", file=sys.stderr)
     last_hb = 0.0
     try:
         while True:
             server.step()
-            if health is not None:
+            if health is not None or tsdb is not None:
                 now = time.monotonic()
                 if now - last_hb >= 1.0:
-                    write_health(health, server)
+                    if health is not None:
+                        write_health(health, server)
+                    if tsdb is not None:
+                        try:
+                            tsdb.append_snapshot(registry.snapshot(),
+                                                 tsdb_seq)
+                            tsdb_seq += 1
+                        except OSError:
+                            tsdb = None   # history is best-effort
                     last_hb = now
     except KeyboardInterrupt:
         pass
     finally:
         server.close()
+        if tsdb is not None:
+            tsdb.close()
         if httpd is not None:
             httpd.shutdown()
     return 0

@@ -100,7 +100,12 @@ GATED_METRICS = {
 # h2d_overlap_frac and chip_msgs_per_sec ride wall clocks on shared
 # runners, so they report advisory-up instead of gating.
 ADVISORY_METRICS = ("pipeline_speedup", "journal_overhead_frac",
-                    "h2d_overlap_frac", "chip_msgs_per_sec")
+                    "h2d_overlap_frac", "chip_msgs_per_sec",
+                    # continuous profiling (ISSUE r16): both ride wall
+                    # clocks/bandwidth probes on shared runners — the
+                    # prof suite enforces its own 3% overhead ceiling
+                    # in-process instead
+                    "prof_overhead_frac", "transfer_compute_ratio")
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
 
@@ -230,6 +235,80 @@ def format_report(report: Dict) -> str:
     return "\n".join(lines)
 
 
+# -- stage-level regression attribution (ISSUE 16) ---------------------
+#
+# Given two metric dicts (TSDB window summaries via
+# telemetry.tsdb.window_summary, or BENCH artifact metrics via
+# load_artifact), name the pipeline stage whose evidence moved the
+# most. Each stage lists every metric that testifies about it: the
+# per-stage latency quantiles (lat_<stage>.p99_ms, flattened TSDB
+# names), the host sampling profiler's stage fractions
+# (prof_stage_frac_*), and the bench-artifact spellings
+# (device_ms_per_batch, p99_ms). A metric missing on either side is
+# simply skipped — the verdict is built from whatever evidence both
+# windows share.
+STAGE_ATTRIBUTION: Dict[str, tuple] = {
+    "parse": ("lat_ingress.p99_ms", "prof_stage_frac_parse",
+              "wire_parse_s"),
+    "plan": ("lat_plan.p99_ms", "prof_stage_frac_plan", "plan_s"),
+    "device": ("lat_device.p99_ms", "prof_stage_frac_dispatch",
+               "prof_stage_frac_collect", "device_ms_per_batch",
+               "engine_side_p99_ms"),
+    "produce": ("lat_produce.p99_ms", "prof_stage_frac_produce"),
+    "e2e": ("lat_e2e.p99_ms", "p99_ms"),
+}
+
+
+def attribute_regression(base: Dict[str, float],
+                         cur: Dict[str, float]) -> Dict:
+    """Rank pipeline stages by how much their evidence degraded
+    between two metric dicts. Returns {"stages": [...worst first...],
+    "suspect": <stage name or None>}; a stage's score is the worst
+    relative increase among its shared metrics (1.0 = unchanged)."""
+    stages: List[dict] = []
+    for stage, names in STAGE_ATTRIBUTION.items():
+        evidence = []
+        score = 1.0
+        for name in names:
+            b, c = base.get(name), cur.get(name)
+            if b is None or c is None or b <= 0:
+                continue
+            ratio = c / b
+            evidence.append({"name": name, "baseline": b,
+                             "current": c, "ratio": round(ratio, 4)})
+            score = max(score, ratio)
+        if evidence:
+            stages.append({"stage": stage, "score": round(score, 4),
+                           "evidence": evidence})
+    stages.sort(key=lambda s: -s["score"])
+    # "e2e" restates the symptom, never the cause: only name it when
+    # no concrete stage moved with it
+    suspect = None
+    for s in stages:
+        if s["score"] > 1.05 and s["stage"] != "e2e":
+            suspect = s["stage"]
+            break
+    if suspect is None and stages and stages[0]["score"] > 1.05:
+        suspect = stages[0]["stage"]
+    return {"stages": stages, "suspect": suspect}
+
+
+def format_attribution(att: Dict) -> str:
+    lines = []
+    for s in att["stages"]:
+        mark = "!" if s["stage"] == att["suspect"] else " "
+        ev = ", ".join(f"{e['name']} x{e['ratio']}"
+                       for e in s["evidence"][:3])
+        lines.append(f"{mark} stage {s['stage']:<8s} "
+                     f"x{s['score']:<8g} {ev}")
+    if att["suspect"]:
+        lines.append(f"! attribution: the {att['suspect']} stage moved "
+                     f"the most")
+    else:
+        lines.append("attribution: no stage moved beyond 5%")
+    return "\n".join(lines)
+
+
 def run_gate(baseline_path: str, current: Dict,
              tolerance: float = 0.25,
              report_path: Optional[str] = None) -> int:
@@ -244,9 +323,59 @@ def run_gate(baseline_path: str, current: Dict,
         return 2
     report = compare(baseline, current, tolerance=tolerance)
     print(format_report(report), file=sys.stderr)
+    if report["regressions"]:
+        # a failing (or would-fail) gate names its suspect stage too —
+        # the same attribution kme-prof --diff prints over TSDB windows
+        att = attribute_regression(baseline["metrics"],
+                                   current["metrics"])
+        report["attribution"] = att
+        print(format_attribution(att), file=sys.stderr)
     if report_path is not None:
         with open(report_path, "w") as f:
             json.dump(report, f, indent=2)
         print(f"kme-bench --gate: report written to {report_path}",
               file=sys.stderr)
     return 0 if report["ok"] else 1
+
+
+def main(argv=None) -> int:
+    """Standalone gate/attribution CLI:
+    `python -m kme_tpu.perfgate BASELINE CURRENT [--attribute]`.
+    Both operands are benchmark artifacts (driver tails, detail JSON,
+    or raw text). --attribute prints the per-stage verdict instead of
+    gating."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(prog="kme-perfgate",
+                                description=main.__doc__)
+    p.add_argument("baseline", help="recorded artifact (BENCH_*.json)")
+    p.add_argument("current", help="artifact to judge against it")
+    p.add_argument("--tolerance", type=float, default=0.25)
+    p.add_argument("--attribute", action="store_true",
+                   help="per-stage regression attribution only "
+                        "(exit 0 clean, 1 when a stage moved >5%%)")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="write the JSON report here")
+    args = p.parse_args(argv)
+    if args.attribute:
+        base = load_artifact(args.baseline)
+        cur = load_artifact(args.current)
+        if not base["metrics"] or not cur["metrics"]:
+            print("kme-perfgate: no metrics on one side; cannot "
+                  "attribute", file=sys.stderr)
+            return 2
+        att = attribute_regression(base["metrics"], cur["metrics"])
+        print(format_attribution(att))
+        if args.report is not None:
+            with open(args.report, "w") as f:
+                json.dump(att, f, indent=2)
+        return 1 if att["suspect"] else 0
+    return run_gate(args.baseline, load_artifact(args.current),
+                    tolerance=args.tolerance, report_path=args.report)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

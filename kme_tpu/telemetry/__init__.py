@@ -10,6 +10,8 @@ order-lifecycle flight recorder, and continuous invariant auditing.
 - audit: shadow-ledger invariant auditor over the journal
 - slo: error-budget objectives over the live latency histograms
 - top: the kme-top live operations dashboard
+- tsdb: on-disk metrics history (fixed-width binary segments)
+- profiler: continuous host/device profiling + trigger captures
 """
 
 from kme_tpu.telemetry.registry import (  # noqa: F401
@@ -47,3 +49,16 @@ from kme_tpu.telemetry.audit import (  # noqa: F401
     replay_repro,
 )
 from kme_tpu.telemetry.slo import SLO  # noqa: F401
+from kme_tpu.telemetry.tsdb import (  # noqa: F401
+    TSDB,
+    flatten_snapshot,
+    read_samples,
+    window_summary,
+)
+from kme_tpu.telemetry.profiler import (  # noqa: F401
+    StageProfiler,
+    TriggerCapture,
+    device_plane,
+    read_transfer_artifact,
+    write_transfer_artifact,
+)

@@ -177,6 +177,61 @@ def build_view(cur: dict, prev: Optional[dict] = None) -> dict:
 
 # -- rendering ---------------------------------------------------------
 
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(vals, width: int = 24) -> str:
+    """Render a value series as a unicode sparkline, newest right.
+    Longer series keep the newest `width` points; constant (or empty)
+    series render flat."""
+    vals = [float(v) for v in vals][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK_BLOCKS[0] * len(vals)
+    n = len(_SPARK_BLOCKS) - 1
+    return "".join(_SPARK_BLOCKS[round((v - lo) / (hi - lo) * n)]
+                   for v in vals)
+
+
+# curated kme-top history columns: what an operator wants at a glance.
+# Monotonic series (counters and histogram .count sub-series) plot
+# their per-sample deltas — a rate shape — instead of an ever-rising
+# ramp that always renders as the same diagonal.
+HISTORY_NAMES = ("service_records", "lat_e2e.p99_ms",
+                 "lat_device.p99_ms", "lat_produce.p99_ms",
+                 "prof_stage_frac_plan", "prof_stage_frac_dispatch",
+                 "prof_stage_frac_produce", "pipeline_depth")
+
+
+def history_lines(store: str, source: str = "serve",
+                  names=HISTORY_NAMES, width: int = 24,
+                  indent: str = "  ") -> list:
+    """Sparkline rows from the on-disk TSDB (kme-serve --tsdb) — the
+    dashboard's look-back columns. Series absent from the store are
+    skipped; an unreadable store degrades to a note, never a crash."""
+    from kme_tpu.telemetry import tsdb as _tsdb
+
+    try:
+        series = _tsdb.query(store, names, source=source)
+    except (OSError, ValueError) as e:
+        return [f"{indent}history unavailable: {e}"]
+    lines = []
+    for name in names:
+        pts = series.get(name) or []
+        if len(pts) < 2:
+            continue
+        vals = [v for _ts, v in pts]
+        if _tsdb._is_monotonic_name(name):
+            vals = [b - a for a, b in zip(vals, vals[1:])]
+        lines.append(f"{indent}{name:<26s} {sparkline(vals, width)} "
+                     f"{_fmt(vals[-1], 3)}")
+    if lines:
+        lines.insert(0, f"{indent[:-2]}history  (oldest -> newest, "
+                        f"source={source})")
+    return lines
+
 
 def _fmt(v, nd=1) -> str:
     if v is None:
@@ -388,6 +443,11 @@ def render(view: dict, width: int = 78) -> list:
     else:
         lines.append("standby  (none)")
 
+    hist = view.get("history")
+    if hist:
+        lines.append("")
+        lines.extend(hist)
+
     if sup is not None:
         lines.append(
             f"superv   restarts={_fmt(sup.get('restarts_total'))} "
@@ -472,6 +532,8 @@ def _curses_loop(args) -> int:
             cur = collect(args.leader, args.standby, args.supervisor,
                           feed=args.feed)
             view = build_view(cur, prev)
+            if args.tsdb:
+                view["history"] = history_lines(args.tsdb)
             prev = cur
             scr.erase()
             maxy, maxx = scr.getmaxyx()
@@ -512,6 +574,10 @@ def main(argv=None) -> int:
                         "leader run dir with group{k}/ children); "
                         "fills in --leader/--standby/--supervisor via "
                         "discover_endpoints")
+    p.add_argument("--tsdb", default=None, metavar="DIR",
+                   help="on-disk metrics history (kme-serve --tsdb): "
+                        "adds sparkline look-back columns to the "
+                        "leader frame")
     p.add_argument("--cluster", action="store_true",
                    help="multi-leader view: one row per discovered "
                         "shard group under --state-root (degraded "
@@ -564,7 +630,10 @@ def main(argv=None) -> int:
             time.sleep(min(args.interval, 1.0))
         cur = collect(args.leader, args.standby, args.supervisor,
                       feed=args.feed)
-        for ln in render(build_view(cur, prev)):
+        view = build_view(cur, prev)
+        if args.tsdb:
+            view["history"] = history_lines(args.tsdb)
+        for ln in render(view):
             print(ln)
         return 0
     try:
@@ -578,7 +647,10 @@ def main(argv=None) -> int:
             while True:
                 cur = collect(args.leader, args.standby,
                               args.supervisor, feed=args.feed)
-                for ln in render(build_view(cur, prev)):
+                view = build_view(cur, prev)
+                if args.tsdb:
+                    view["history"] = history_lines(args.tsdb)
+                for ln in render(view):
                     print(ln)
                 prev = cur
                 time.sleep(args.interval)
