@@ -142,6 +142,31 @@ TRACE_SCOPES: Dict[str, Set[str]] = {
 # RNG anywhere in the derivation, the frame codec, or the snapshot
 # save/restore forks the frame stream silently. Merged into replay_fns
 # per file by _RuleVisitor, like TRACE_SCOPES.
+# X-ray scopes (KME-D00x, same determinism rules): time-travel
+# materialization and live watchpoints (ISSUE 17). A watchpoint must
+# be a pure function of (predicate, ledger-at-barrier): the SAME seeded
+# run must produce the SAME hit set, and an offline `kme-xray eval` at
+# the captured offset must re-fire — a wall clock or RNG anywhere in
+# predicate parsing/evaluation or in the snapshot+replay walk forks
+# live hits from their own repro commands. Merged into replay_fns per
+# file by _RuleVisitor, like TRACE_SCOPES.
+XRAY_SCOPES: Dict[str, Set[str]] = {
+    "kme_tpu/telemetry/xray.py": {
+        # offset-addressed materialization: anchor choice + replay
+        "oldest_materializable", "_fetch_records", "_parse_replay",
+        "_engine_from_snapshot", "materialize", "resolve_trace",
+        # predicate grammar + evaluation (live AND offline paths)
+        "parse_watch", "_cmp", "measure", "eval_predicate",
+        "measure_engine", "eval_engine", "book_summary",
+        # barrier-side observation (everything but the capture write)
+        "seed", "observe_lines", "observe_events", "_repro_line",
+        # bisection state projection + comparison
+        "_journal_batches", "_batch_end_off", "_canon",
+        "shadow_canon", "engine_canon", "state_diff",
+        # cluster-cut accounting
+        "_open_margin"},
+}
+
 FEED_SCOPES: Dict[str, Set[str]] = {
     "kme_tpu/feed/frames.py": {
         "_envelope", "encode_delta", "encode_tob", "encode_depth",
@@ -236,7 +261,8 @@ class _RuleVisitor(ast.NodeVisitor):
         self.hot_fns = HOT_SCOPES.get(relpath, set())
         self.replay_fns = (REPLAY_SCOPES.get(relpath, set())
                            | TRACE_SCOPES.get(relpath, set())
-                           | FEED_SCOPES.get(relpath, set()))
+                           | FEED_SCOPES.get(relpath, set())
+                           | XRAY_SCOPES.get(relpath, set()))
         self.traced = relpath.startswith(TRACED_DIRS)
 
     # -- bookkeeping ----------------------------------------------------

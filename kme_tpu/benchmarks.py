@@ -2208,7 +2208,8 @@ def bench_prof(events: int = 20_000, seed: int = 0,
     the SAME seeded stream is served twice through an in-process
     MatchService — once with observability off, once with the full
     always-on plane (host sampling profiler + heartbeat thread + TSDB
-    history + transfer/compute artifact) — at matched batching.
+    history + transfer/compute artifact + an armed watchpoint,
+    ISSUE 17) — at matched batching.
 
     Three hard assertions, not statistics:
     - overhead: best-of-`repeats` serve walls must agree within
@@ -2251,7 +2252,13 @@ def bench_prof(events: int = 20_000, seed: int = 0,
         health = None
         if observe:
             kw = dict(tsdb=os.path.join(td, "tsdb"), profile=True,
-                      profile_artifact=os.path.join(td, "xfer.json"))
+                      profile_artifact=os.path.join(td, "xfer.json"),
+                      # a representative armed watchpoint rides the
+                      # observe run: the 3% ceiling + MatchOut parity
+                      # asserts below now also bound the watch plane
+                      # (ISSUE 17: watchpoints must be free)
+                      watch=["balance[1]<0"],
+                      capture_dir=os.path.join(td, "captures"))
             health = os.path.join(td, "serve.health")
         svc = MatchService(broker, engine="oracle", compat="fixed",
                            batch=batch, **kw)

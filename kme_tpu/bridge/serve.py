@@ -220,6 +220,14 @@ def main(argv=None) -> int:
     p.add_argument("--capture-p99-us", type=int, default=None,
                    metavar="US", help="exemplar e2e threshold that "
                         "fires a capture even without SLO burn")
+    p.add_argument("--watch", action="append", default=None,
+                   metavar="EXPR",
+                   help="arm a live watchpoint evaluated at every "
+                        "batch barrier (repeatable): balance[AID]<0, "
+                        "position[AID,SYM]>X, depth[SYM]>=N, "
+                        "spread[SYM]==0. Read-only — never gates "
+                        "admission, never touches MatchOut; hits "
+                        "write bounded captures to --capture-dir")
     p.add_argument("--annotate-rejects", action="store_true",
                    help="emit an ADDITIVE 'REJ'-keyed MatchOut record "
                         "naming each rejected order's rej_* reason "
@@ -233,6 +241,17 @@ def main(argv=None) -> int:
     from kme_tpu.bridge.provision import group_topics, provision
     from kme_tpu.bridge.service import MatchService
     from kme_tpu.bridge.tcp import parse_addr, serve_broker
+
+    if args.watch:
+        # fail fast on grammar errors instead of a mid-run warning
+        from kme_tpu.telemetry.xray import XrayError, parse_watch
+
+        try:
+            for expr in args.watch:
+                parse_watch(expr)
+        except XrayError as e:
+            print(f"kme-serve: {e}", file=sys.stderr)
+            return 2
 
     group = None
     if args.group is not None:
@@ -324,6 +343,7 @@ def main(argv=None) -> int:
                        profile_artifact=args.profile_artifact,
                        capture_dir=args.capture_dir,
                        capture_p99_us=args.capture_p99_us,
+                       watch=args.watch,
                        slo=(None if args.slo_p99_ms is None else {
                            "stage": args.slo_stage,
                            "p99_ms": args.slo_p99_ms,

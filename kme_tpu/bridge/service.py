@@ -73,7 +73,8 @@ class MatchService:
                  profile: bool = False,
                  profile_artifact: Optional[str] = None,
                  capture_dir: Optional[str] = None,
-                 capture_p99_us: Optional[int] = None) -> None:
+                 capture_p99_us: Optional[int] = None,
+                 watch=None) -> None:
         if engine not in ("lanes", "seq", "oracle", "native"):
             raise ValueError(f"unknown engine {engine!r}")
         if compat not in ("java", "fixed"):
@@ -201,6 +202,11 @@ class MatchService:
         self.tsdb = None
         self.profiler = None
         self.capture = None
+        # live watchpoints (ISSUE 17): deterministic predicates over the
+        # shadow ledger, evaluated at every batch barrier. Read-only:
+        # they never gate admission and never touch MatchOut bytes
+        self._watch_arg = list(watch or [])
+        self.watch = None
         # monotonic heartbeat-sample sequence: persisted across restart
         # via the checkpoint's additive `extra` meta so TSDB ingestion
         # dedups replayed samples exactly like the broker dedups
@@ -381,7 +387,58 @@ class MatchService:
         self.journal = j
         if j is not None and resumed:
             j.rewind_to_offset(self.offset)
+        # journal-side corruption drill (KME_AUDIT_TAMPER=journal_fill_qty):
+        # one-shot, bumps the first journaled fill's taker quantity in a
+        # COPY of the output line groups — the journal then LIES about a
+        # batch while MatchOut stays untouched, which is exactly the
+        # divergence class `kme-xray --bisect` must pin to a batch (the
+        # auditor, a journal observer, trips on the same tampered events
+        # and its repro dump carries the ready-to-run bisect line)
+        self._journal_tamper = None
+        self._tampered_batch = None
+        tamper_env = os.environ.get("KME_AUDIT_TAMPER", "")
+        if j is not None and tamper_env.startswith("journal_fill_qty"):
+            from kme_tpu import opcodes as op
+            import json as _json
+
+            # "journal_fill_qty@K" arms the tamper from the K-th
+            # journaled batch on (default 0) — so the bisect drill has
+            # a non-trivial prefix of clean batches to rule out
+            _, _, at_s = tamper_env.partition("@")
+            arm_batch = int(at_s) if at_s.isdigit() else 0
+            done = []
+            seen = [0]     # record_batch calls == journal batch ids
+
+            def line_tamper(out):
+                b = seen[0]
+                seen[0] += 1
+                if done or b < arm_batch:
+                    return out
+                for gi, grp in enumerate(out):
+                    if len(grp) < 4:   # no fill pairs (IN + result echo)
+                        continue
+                    for k in range(1, len(grp) - 1, 2):
+                        key, _, val = grp[k + 1].partition(" ")
+                        try:
+                            tk = _json.loads(val)
+                        except ValueError:
+                            continue
+                        if tk.get("action") not in (op.BOUGHT, op.SOLD):
+                            continue   # not a fill-pair taker echo
+                        tk["size"] = int(tk["size"]) + 1
+                        new = list(grp)
+                        new[k + 1] = (f"{key} "
+                                      f"{_json.dumps(tk, separators=(',', ':'))}")
+                        out = list(out)
+                        out[gi] = new
+                        done.append(True)
+                        self._tampered_batch = b
+                        return out
+                return out
+
+            self._journal_tamper = line_tamper
         self._init_profiling(resumed)
+        self._init_watch(resumed)
         if not self._audit_arg:
             return
         if self._compat != "fixed":
@@ -401,7 +458,9 @@ class MatchService:
         self.auditor = InvariantAuditor(
             registry=self.telemetry, repro_dir=self._audit_repro_dir,
             on_violation=on_violation,
-            checkpoint_ref=self.checkpoint_dir)
+            checkpoint_ref=self.checkpoint_dir,
+            journal_ref=getattr(j, "path", None),
+            log_ref=getattr(self.broker, "_persist_dir", None))
         if resumed and self._session is not None:
             self.auditor.seed(self._session.export_state(),
                               self._session.histograms())
@@ -422,6 +481,48 @@ class MatchService:
 
             self.auditor.tamper = tamper
         j.observers.append(self.auditor.observe)
+
+    def _init_watch(self, resumed: bool) -> None:
+        """Live watchpoint wiring (ISSUE 17). Predicates evaluate
+        inline at the batch barrier — directly against the serving
+        OracleEngine when that IS the engine (zero-derivation, the
+        kme-bench prof 3% budget), else against an auditor-shaped
+        shadow ledger fed from the batch's own (untampered) output
+        lines. Both are pure functions of exported state, so two
+        seeded runs fire identical (offset, predicate) hit sets. Hits
+        write bounded TriggerCapture-style captures into --capture-dir
+        carrying the offset, the batch's slow-order trace exemplars
+        and the `kme-xray` one-liner that reproduces the hit
+        offline."""
+        self.watch = None
+        if not self._watch_arg:
+            return
+        if self._compat != "fixed":
+            print("kme-serve: --watch needs fixed-mode money "
+                  "semantics; watchpoints disabled for compat=java",
+                  file=sys.stderr)
+            return
+        from kme_tpu.telemetry.xray import WatchEngine
+
+        repro = {"log_dir": getattr(self.broker, "_persist_dir", None),
+                 "topic": self.topic_in,
+                 "checkpoint_dir": self.checkpoint_dir}
+        self.watch = WatchEngine(
+            self._watch_arg, out_dir=self._capture_dir,
+            registry=self.telemetry, repro=repro)
+        if resumed:
+            state = None
+            if self._session is not None:
+                state = self._session.export_state()
+            elif self._oracle is not None and not self._oracle.java:
+                state = self._oracle.export_state()
+            if state is not None:
+                self.watch.seed(state)
+            else:
+                print("kme-serve: --watch cannot seed its shadow from "
+                      "a resumed native engine; watchpoints disabled",
+                      file=sys.stderr)
+                self.watch = None
 
     def _init_profiling(self, resumed: bool) -> None:
         """Continuous profiling & history wiring (ISSUE 16): the TSDB
@@ -993,7 +1094,10 @@ class MatchService:
                 # machine (latency can trip shedding before backlog does)
                 ctl.observe_latency(e2e_hot)
         if self.journal is not None and (out or drops):
-            self.journal.record_batch(out or [], reasons=reasons,
+            jout = out or []
+            if self._journal_tamper is not None:
+                jout = self._journal_tamper(jout)
+            self.journal.record_batch(jout, reasons=reasons,
                                       offsets=offs[:len(out or [])],
                                       drops=drops)
         if n:
@@ -1006,6 +1110,16 @@ class MatchService:
                 int(plan_d * 1e6), int(dev_d * 1e6),
                 int(self._last_produce_s * 1e6),
                 batch=self._batch_ordinal)
+        if self.watch is not None and n:
+            # batch barrier: the serving oracle IS the deterministic
+            # state machine, so predicates read it directly — no
+            # lifecycle re-derivation, no shadow ledger, and never the
+            # journal-tamper copy. After _stamp_orders so a firing
+            # capture embeds this batch's trace exemplars. Drop-only
+            # batches change no state and cannot transition a
+            # predicate, so they are skipped.
+            self.watch.observe_engine(self._oracle, offs[n - 1],
+                                      exemplars=self._slow)
         # batch-boundary commit (H5): offsets advance only after the
         # outputs for the whole batch are on MatchOut
         self.offset = recs[-1].offset + 1
@@ -1157,9 +1271,14 @@ class MatchService:
         ctl = getattr(self.broker, "overload", None)
         if ctl is not None and e2e_hot > 0:
             ctl.observe_latency(e2e_hot)
-        if self.journal is not None and n:
+        out = None
+        if (self.journal is not None or self.watch is not None) and n:
             out = self._lines_of(buf, line_off, msg_lines)
-            self.journal.record_batch(out, reasons=reasons,
+        if self.journal is not None and n:
+            jout = out
+            if self._journal_tamper is not None:
+                jout = self._journal_tamper(jout)
+            self.journal.record_batch(jout, reasons=reasons,
                                       offsets=offs, drops=[])
         if n:
             self._stamp_orders(
@@ -1167,6 +1286,10 @@ class MatchService:
                 fetch_us, done_us, int(plan_d * 1e6),
                 int(dev_d * 1e6), int(self._last_produce_s * 1e6),
                 batch=ordinal)
+        if self.watch is not None and out:
+            self.watch.observe_lines(out, reasons=reasons,
+                                     offsets=offs, drops=[],
+                                     exemplars=self._slow)
         self.offset = end_off
         if not self.follower:
             faults.kill_now("serve.kill", offset=self.offset)
@@ -1603,6 +1726,9 @@ class MatchService:
         # cadence whether or not a supervisor is watching
         if health_file is not None or self.tsdb is not None:
             beat_stop = threading.Event()
+            # readers (kme-agg staleness detection) need the cadence
+            # to judge "hasn't advanced in 3 intervals"
+            self._hb_every = float(health_every)
             state = self
 
             def beater():
@@ -1702,6 +1828,7 @@ class MatchService:
                        "role": "follower" if self.follower else "leader",
                        "epoch": self.epoch,
                        "sample_seq": seq,
+                       "every": getattr(self, "_hb_every", 1.0),
                        "metrics": snap}, f)
         os.replace(tmp, path)
         self._append_tsdb(snap, seq)

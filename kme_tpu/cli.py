@@ -577,12 +577,40 @@ def agg_main(argv=None) -> int:
                 sources.append(fp)
     if not sources:
         p.error("no sources: give URLs/paths or --state-root")
+    import time as _time
+
     snaps = []
+    stale = {}
+    now = _time.time()
     for src in sources:
         node = scrape(src)      # same path as kme-top: never raises
         snaps.append((src, node["metrics"] if node["ok"] else None))
+        # staleness: a heartbeat FILE that scraped fine but whose
+        # writer stopped advancing (sample_seq/mtime frozen for more
+        # than 3 write intervals) describes the past, not the present.
+        # Live HTTP scrapes are fresh by construction; a heartbeat
+        # that says "closing" froze on purpose.
+        hb = node.get("hb")
+        if (node["ok"] and hb and not hb.get("closing")
+                and not src.startswith(("http://", "https://"))):
+            every = float(hb.get("every") or 1.0)
+            age = None
+            if isinstance(hb.get("time"), (int, float)):
+                age = now - float(hb["time"])
+            else:
+                try:
+                    import os as _os
+
+                    age = now - _os.path.getmtime(src)
+                except OSError:
+                    pass
+            if age is not None and age > 3.0 * every:
+                stale[src] = {"age_s": round(age, 3),
+                              "intervals": round(age / every, 2),
+                              "sample_seq": hb.get("sample_seq")}
     doc = dtrace.aggregate(snaps, slo_ms=args.slo_ms,
-                           slo_target=args.slo_target)
+                           slo_target=args.slo_target,
+                           stale=stale or None)
     hist_sources = []
     if args.history:
         import os as _os
@@ -652,12 +680,40 @@ def prof_main(argv=None) -> int:
                         "two TSDB stores (window summaries) or two "
                         "recorded BENCH/driver artifacts — each "
                         "operand may be either")
+    p.add_argument("--captures", default=None, metavar="DIR",
+                   help="list and pretty-print the capture_NNN.json "
+                        "trigger captures in DIR (kme-serve "
+                        "--capture-dir: SLO/p99 TriggerCaptures and "
+                        "kme-xray watchpoint hits share the format)")
     args = p.parse_args(argv)
     import json
     import os
 
     from kme_tpu.telemetry import tsdb
 
+    if args.captures is not None:
+        from kme_tpu.telemetry.profiler import (format_capture,
+                                                list_captures)
+
+        paths = list_captures(args.captures)
+        if not paths:
+            print(f"kme-prof: no captures under {args.captures}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            docs = []
+            for pth in paths:
+                with open(pth) as f:
+                    docs.append(dict(json.load(f), path=pth))
+            print(json.dumps(docs, indent=1, sort_keys=True))
+            return 0
+        for pth in paths:
+            try:
+                print(format_capture(pth))
+            except (OSError, ValueError) as e:
+                print(f"kme-prof: unreadable capture {pth}: {e}",
+                      file=sys.stderr)
+        return 0
     if args.artifact is not None:
         from kme_tpu.telemetry import read_transfer_artifact
 
@@ -936,6 +992,227 @@ def chaos_main(argv=None) -> int:
     return _main(argv)
 
 
+def xray_main(argv=None) -> int:
+    """Time-travel state inspection over the durable MatchIn log:
+    materialize oracle state at any retained offset (nearest snapshot +
+    deterministic replay), bisect the first divergent batch between a
+    journal and a fresh replay, evaluate watchpoint predicates offline,
+    and take a consistent cross-group cut. Strictly read-only: MatchIn
+    and MatchOut bytes are never touched."""
+    p = argparse.ArgumentParser(prog="kme-xray",
+                                description=xray_main.__doc__)
+    p.add_argument("query", nargs="*", metavar="QUERY",
+                   help="point query: 'balance AID' | 'order AID:OID' "
+                        "| 'book SID' | 'state' | \"eval 'EXPR'\" "
+                        "(EXPR uses the watchpoint grammar, e.g. "
+                        "balance[3]<0, depth[1]>=8, spread[2]==0)")
+    p.add_argument("--log-dir", default=None,
+                   help="broker persist dir holding the durable topic "
+                        "logs (default: <checkpoint-dir>/broker-log)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="snapshot dir to anchor replays (kme-serve "
+                        "--checkpoint-dir); omit to replay cold from "
+                        "offset 0 (requires --allow-cold)")
+    p.add_argument("--topic", default="MatchIn")
+    p.add_argument("--at", type=int, default=None, metavar="OFFSET",
+                   help="materialize state AFTER the MatchIn record at "
+                        "this offset (default: log end)")
+    p.add_argument("--at-trace", default=None, metavar="0xTID",
+                   help="resolve a dtrace trace id to its MatchIn "
+                        "offset and materialize there")
+    p.add_argument("--groups", type=int, default=1,
+                   help="group count used when resolving --at-trace "
+                        "ids minted by a grouped deployment")
+    p.add_argument("--allow-cold", action="store_true",
+                   help="permit a full replay from offset 0 when no "
+                        "snapshot covers the target")
+    p.add_argument("--book-slots", type=int, default=None)
+    p.add_argument("--max-fills", type=int, default=None)
+    p.add_argument("--bisect", action="store_true",
+                   help="binary-search the journal for the first batch "
+                        "whose recorded effects diverge from a fresh "
+                        "oracle replay; writes a minimized repro")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="journal file for --bisect")
+    p.add_argument("--lo", type=int, default=None, metavar="BATCH",
+                   help="--bisect window start (journal batch id)")
+    p.add_argument("--hi", type=int, default=None, metavar="BATCH",
+                   help="--bisect window end (inclusive batch id)")
+    p.add_argument("--repro-dir", default=None,
+                   help="where --bisect writes its repro dump "
+                        "(default: next to the journal)")
+    p.add_argument("--replay-repro", default=None, metavar="PATH",
+                   help="re-run a bisect repro dump offline and check "
+                        "the recorded diff reproduces")
+    p.add_argument("--cluster", action="store_true",
+                   help="consistent cut across every group under "
+                        "--state-root: per-group cash + open margin, "
+                        "pending transfer reserve, and global cash "
+                        "conservation vs a single-leader replay")
+    p.add_argument("--state-root", default=None,
+                   help="chaos/cluster layout root (front.in + "
+                        "group<k>/state/) for --cluster")
+    p.add_argument("--input", default=None, metavar="PATH",
+                   help="merged pre-split input for --cluster "
+                        "(default: <state-root>/front.in)")
+    p.add_argument("--prefund", type=int, default=8,
+                   help="per-group transfer prefund the deployment "
+                        "ran with (--cluster; must match kme-front)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    import json
+
+    from kme_tpu.telemetry import xray
+
+    try:
+        if args.replay_repro is not None:
+            res = xray.replay_bisect_repro(args.replay_repro)
+            if args.json:
+                print(json.dumps(res, indent=1, sort_keys=True))
+            else:
+                print(f"repro batch {res['batch']}: "
+                      f"{'reproduces' if res['match'] else 'DOES NOT reproduce'}")
+                for store, line in sorted(res["diff"].items()):
+                    print(f"  {store}: {line}")
+            return 0 if res["match"] else 1
+
+        if args.cluster:
+            if not args.state_root:
+                p.error("--cluster requires --state-root")
+            rep = xray.cluster_cut(
+                args.state_root, at=args.at, input_path=args.input,
+                prefund=args.prefund, book_slots=args.book_slots,
+                max_fills=args.max_fills)
+            if args.json:
+                print(json.dumps(rep, indent=1, sort_keys=True))
+            else:
+                print(f"cut @ {rep['watermark']} input lines "
+                      f"({len(rep['groups'])} groups)")
+                for k in sorted(rep["groups"]):
+                    g = rep["groups"][k]
+                    print(f"  group{k}: cut={g['cut']} "
+                          f"cash={g['cash']} margin={g['open_margin']} "
+                          f"accounts={g['accounts']} "
+                          f"resting={g['resting_orders']} "
+                          f"(anchor={g['anchor']} "
+                          f"replayed={g['replayed']})")
+                print(f"  pending transfer reserve: "
+                      f"{rep['pending_reserve_total']} "
+                      f"(shortfalls={rep['transfer_shortfalls']})")
+                print(f"  cluster cash+reserve={rep['cluster']['cash']}"
+                      f" margin={rep['cluster']['open_margin']} "
+                      f"gross={rep['cluster']['gross']}")
+                print(f"  single-leader  cash="
+                      f"{rep['single_leader']['cash']} "
+                      f"margin={rep['single_leader']['open_margin']} "
+                      f"gross={rep['single_leader']['gross']}")
+                print("  conserved: "
+                      + ("yes" if rep["conserved"]
+                         else f"NO — {rep['delta']}"))
+            return 0 if rep["conserved"] else 1
+
+        # Point queries and bisection both need the log location.
+        log_dir = args.log_dir
+        if log_dir is None and args.checkpoint_dir:
+            import os as _os
+            log_dir = _os.path.join(args.checkpoint_dir, "broker-log")
+        if log_dir is None:
+            p.error("--log-dir (or --checkpoint-dir) is required")
+
+        if args.bisect:
+            if not args.journal:
+                p.error("--bisect requires --journal")
+            res = xray.bisect(
+                args.journal, log_dir, topic=args.topic,
+                ckpt_dir=args.checkpoint_dir, lo=args.lo, hi=args.hi,
+                book_slots=args.book_slots, max_fills=args.max_fills,
+                repro_dir=args.repro_dir)
+            if args.json:
+                print(json.dumps(res, indent=1, sort_keys=True))
+            elif not res["divergent"]:
+                print(f"no divergence across {res['window_batches']} "
+                      f"journal batches ({res['replays']} replays)")
+            else:
+                print(f"first divergent batch: {res['batch']} "
+                      f"(offset {res['first_divergent_offset']}, "
+                      f"{res['replays']} replays)")
+                for store, line in sorted(res["diff"].items()):
+                    print(f"  {store}: {line}")
+                if res.get("repro"):
+                    print(f"repro: {res['repro']}")
+            return 1 if res["divergent"] else 0
+
+        at = args.at
+        if args.at_trace is not None:
+            tid = int(args.at_trace, 0)
+            off = xray.resolve_trace(tid, log_dir, topic=args.topic,
+                                     ngroups=args.groups)
+            if off is None:
+                raise xray.XrayError(
+                    f"trace id {args.at_trace} not found in "
+                    f"{args.topic} under {log_dir}")
+            at = off + 1
+            if not args.json:
+                print(f"# trace {args.at_trace} -> offset {off}")
+
+        engine, anchor, replayed = xray.materialize(
+            log_dir, at, topic=args.topic,
+            ckpt_dir=args.checkpoint_dir,
+            allow_cold=args.allow_cold or not args.checkpoint_dir,
+            book_slots=args.book_slots, max_fills=args.max_fills)
+
+        q = args.query or ["state"]
+        what = q[0]
+        out = {"topic": args.topic, "at": at, "anchor": anchor,
+               "replayed": replayed}
+        if what == "balance":
+            if len(q) != 2:
+                p.error("usage: balance AID")
+            aid = int(q[1])
+            bal = engine.balances.get(aid)
+            out.update(query=f"balance[{aid}]",
+                       value=None if bal is None else int(bal))
+        elif what == "order":
+            if len(q) != 2 or ":" not in q[1]:
+                p.error("usage: order AID:OID")
+            aid_s, _, oid_s = q[1].partition(":")
+            rec = engine.export_state()["orders"].get(int(oid_s))
+            if rec is not None and rec["aid"] != int(aid_s):
+                rec = None
+            out.update(query=f"order[{q[1]}]", value=rec)
+        elif what == "book":
+            if len(q) != 2:
+                p.error("usage: book SID")
+            sid = int(q[1])
+            out.update(query=f"book[{sid}]",
+                       value=xray.book_summary(engine, sid))
+        elif what == "eval":
+            if len(q) != 2:
+                p.error("usage: eval 'EXPR'")
+            pred = xray.parse_watch(q[1])
+            fired, val = xray.eval_engine(pred, engine)
+            out.update(query=q[1], value=val, fired=fired)
+        elif what == "state":
+            out.update(query="state",
+                       value=xray.engine_canon(engine))
+        else:
+            p.error(f"unknown query {what!r} (balance | order | "
+                    f"book | state | eval)")
+        if args.json:
+            print(json.dumps(out, indent=1, sort_keys=True))
+        else:
+            print(f"# {out['query']} @ {args.topic}"
+                  f"[{'end' if at is None else at}] "
+                  f"(anchor={anchor} replayed={replayed})")
+            print(json.dumps(out["value"], indent=1, sort_keys=True))
+            if "fired" in out:
+                print(f"fired: {out['fired']}")
+        return 1 if out.get("fired") else 0
+    except xray.XrayError as e:
+        print(f"kme-xray: {e}", file=sys.stderr)
+        return 2
+
+
 def lint_main(argv=None) -> int:
     """Repo-native static analysis (hot-path/determinism/tracer/lock
     rules + ruff): see kme_tpu/analysis/."""
@@ -949,7 +1226,7 @@ def main(argv=None) -> int:
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
         "supervise", "standby", "trace", "chaos", "top", "lint",
-        "front", "agg", "feed", "reshard", "prof"))
+        "front", "agg", "feed", "reshard", "prof", "xray"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
@@ -961,6 +1238,7 @@ def main(argv=None) -> int:
             "top": top_main, "lint": lint_main, "front": front_main,
             "agg": agg_main, "feed": feed_main,
             "reshard": reshard_main, "prof": prof_main,
+            "xray": xray_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

@@ -668,3 +668,100 @@ class OracleEngine:
         self.orders.pop(oid, None)  # store.delete: no-op if absent
         self._post_remove_adjustments(rec)
         return True
+
+    # ------------------------------------------------------------------
+    # state export / adoption (fixed mode): the shared audit/xray shape
+
+    def export_state(self) -> dict:
+        """The cross-engine state shape the auditor checks against and
+        the seq/lane sessions export (seqsession._canon_to_export):
+        balances, position tuples, resting orders with an `is_buy` tag,
+        and the existing-symbol set. Fixed mode only — java-mode keys
+        (signed sids, Q11 garbage position keys) have no canonical
+        projection."""
+        if self.java:
+            raise ValueError("export_state is a fixed-mode projection")
+        return {
+            "balances": dict(self.balances),
+            "positions": dict(self.positions),
+            "orders": {oid: {"aid": r.aid, "sid": r.sid,
+                             "price": r.price, "size": r.size,
+                             "is_buy": r.action == op.BUY}
+                       for oid, r in self.orders.items()},
+            "books": {k // 2: True for k in self.books if k % 2 == 0},
+        }
+
+    @classmethod
+    def from_export(cls, state: dict,
+                    book_slots: Optional[int] = None,
+                    max_fills: Optional[int] = None) -> "OracleEngine":
+        """Adopt an exported state dict (fixed mode): rebuild the book
+        bitmaps, price buckets and FIFO linked lists from the flat
+        resting-order set. FIFO order within a price bucket is restored
+        by ascending oid — exact for monotonically-minted oid streams
+        (every workload generator here), and exactly what audit.py's
+        seed() assumes for the same export."""
+        eng = cls("fixed", book_slots=book_slots, max_fills=max_fills)
+        eng.balances = {int(a): int(v)
+                        for a, v in state.get("balances", {}).items()}
+        eng.positions = {(int(a), int(s)): (int(amt), int(av))
+                         for (a, s), (amt, av)
+                         in state.get("positions", {}).items()}
+        for sid in state.get("books", {}):
+            eng._add_symbol(int(sid))
+        for oid in sorted(state.get("orders", {})):
+            o = state["orders"][oid]
+            is_buy = bool(o["is_buy"])
+            sid = int(o["sid"])
+            bkey = eng._order_book_key(sid, is_buy)
+            if bkey not in eng.books:    # resting order implies books
+                eng.books[jl.jlong(2 * sid)] = (0, 0)
+                eng.books[jl.jlong(2 * sid + 1)] = (0, 0)
+            price = int(o["price"])
+            bucket_key = eng._bucket_key(bkey, price)
+            rec = _StoredOrder(op.BUY if is_buy else op.SELL, int(oid),
+                               int(o["aid"]), sid, price, int(o["size"]))
+            book = eng.books[bkey]
+            if not _check_bit(book, price):
+                eng.buckets[bucket_key] = (rec.oid, rec.oid)
+                eng.books[bkey] = _with_bit_set(book, price)
+            else:
+                first_ptr, last_ptr = eng.buckets[bucket_key]
+                tail = eng.orders[last_ptr].copy()
+                tail.next = rec.oid
+                rec.prev = tail.oid
+                eng.orders[last_ptr] = tail
+                eng.buckets[bucket_key] = (first_ptr, rec.oid)
+            eng.orders[rec.oid] = rec
+        return eng
+
+    def book_levels(self, sid: int) -> dict:
+        """Read-only ladder view of one symbol (fixed mode): per-side
+        [(price, [(oid, aid, size), ...FIFO...])], best-first."""
+        if self.java:
+            raise ValueError("book_levels is a fixed-mode view")
+        out: dict = {"sid": int(sid), "exists": False,
+                     "buys": [], "sells": []}
+        for side_name, side in (("buys", 0), ("sells", 1)):
+            bkey = jl.jlong(2 * sid + side)
+            book = self.books.get(bkey)
+            if book is None:
+                continue
+            out["exists"] = True
+            levels = []
+            for price in range(126):
+                if not _check_bit(book, price):
+                    continue
+                bucket = self.buckets.get(self._bucket_key(bkey, price))
+                if bucket is None:
+                    continue
+                rows, ptr = [], bucket[0]
+                while ptr is not None:
+                    rec = self.orders[ptr]
+                    rows.append((rec.oid, rec.aid, rec.size))
+                    ptr = rec.next
+                levels.append((price, rows))
+            # best-first: highest bid, lowest ask
+            out[side_name] = (list(reversed(levels)) if side == 0
+                              else levels)
+        return out

@@ -656,9 +656,28 @@ def save_oracle(ckpt_dir: str, oracle, offset: int,
     return path
 
 
+def load_oracle_file(path: str):
+    """Restore ONE oracle snapshot file (digest-verified). Raises on
+    corruption — callers own the fallback-to-older decision."""
+    import pickle
+
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    if blob.get("version") != 1 or blob.get("kind") != "oracle":
+        raise ValueError("unsupported snapshot")
+    if "engine_pkl" in blob:
+        got = hashlib.sha256(blob["engine_pkl"]).hexdigest()
+        if got != blob.get("digest"):
+            raise ValueError(
+                f"content digest mismatch (stored "
+                f"{str(blob.get('digest'))[:12]}…, computed "
+                f"{got[:12]}…): corrupt snapshot")
+        return pickle.loads(blob["engine_pkl"])
+    return blob["engine"]   # pre-digest snapshot format
+
+
 def load_oracle(ckpt_dir: str):
     """Returns (oracle, offset) or (None, 0)."""
-    import pickle
     import sys
 
     if not os.path.isdir(ckpt_dir):
@@ -671,23 +690,19 @@ def load_oracle(ckpt_dir: str):
     cands.sort(reverse=True)
     for offset, path in cands:
         try:
-            with open(path, "rb") as f:
-                blob = pickle.load(f)
-            if blob.get("version") != 1 or blob.get("kind") != "oracle":
-                raise ValueError("unsupported snapshot")
-            if "engine_pkl" in blob:
-                got = hashlib.sha256(blob["engine_pkl"]).hexdigest()
-                if got != blob.get("digest"):
-                    raise ValueError(
-                        f"content digest mismatch (stored "
-                        f"{str(blob.get('digest'))[:12]}…, computed "
-                        f"{got[:12]}…): corrupt snapshot")
-                return pickle.loads(blob["engine_pkl"]), offset
-            return blob["engine"], offset   # pre-digest snapshot format
+            return load_oracle_file(path), offset
         except Exception as e:
             print(f"kme_tpu.checkpoint: skipping unreadable snapshot "
                   f"{path}: {e}", file=sys.stderr)
     return None, 0
+
+
+def restore_seq_snapshot(path: str, cfg=None):
+    """Restore ONE .npz snapshot file (lanes/seq/seqjava canonical
+    form) into a SeqSession. Raises on corruption or capacity mismatch
+    — the offset-addressed loaders (telemetry/xray.py) use this to
+    restore a SPECIFIC anchor instead of the newest snapshot."""
+    return _restore_seq_one(path, cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -731,6 +746,28 @@ def snapshot_extra(ckpt_dir: str, offset: int) -> dict:
         except Exception:
             return {}
     return {}
+
+
+def all_snapshots(ckpt_dir: str) -> List[Tuple[int, str]]:
+    """(offset, path) pairs across ALL snapshot kinds (.npz/.nat/.pkl),
+    newest first. The offset-addressed restore path (telemetry/xray.py)
+    walks this to find the nearest anchor <= a target offset; ties at
+    the same offset sort .pkl > .npz > .nat so the exact-state oracle
+    snapshot wins when several kinds exist."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    rank = {".pkl": 2, ".npz": 1, ".nat": 0}
+    out = []
+    for name in os.listdir(ckpt_dir):
+        for pat in _ALL_SNAP_RES:
+            m = pat.match(name)
+            if m:
+                ext = os.path.splitext(name)[1]
+                out.append((int(m.group(1)), rank.get(ext, 0),
+                            os.path.join(ckpt_dir, name)))
+                break
+    out.sort(reverse=True)
+    return [(off, path) for off, _r, path in out]
 
 
 def oldest_retained_offset(ckpt_dir: str) -> Optional[int]:

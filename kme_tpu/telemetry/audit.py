@@ -93,7 +93,9 @@ class InvariantAuditor:
     def __init__(self, registry=None, repro_dir: Optional[str] = None,
                  on_violation: Optional[Callable] = None,
                  max_dumps: int = 8,
-                 checkpoint_ref: Optional[str] = None) -> None:
+                 checkpoint_ref: Optional[str] = None,
+                 journal_ref: Optional[str] = None,
+                 log_ref: Optional[str] = None) -> None:
         self.balances: Dict[int, int] = {}
         # (aid, sid) -> (amount, available)
         self.positions: Dict[Tuple[int, int], Tuple[int, int]] = {}
@@ -108,6 +110,8 @@ class InvariantAuditor:
         self.tamper: Optional[Callable] = None
         self.repro_dir = repro_dir
         self.checkpoint_ref = checkpoint_ref
+        self.journal_ref = journal_ref
+        self.log_ref = log_ref
         self.max_dumps = max_dumps
         self.on_violation = on_violation
         self._unbounded_credit = False
@@ -521,13 +525,26 @@ class InvariantAuditor:
                    "pre_state": pre, "events": events,
                    "inputs": ([ln for grp in lines for ln in grp]
                               if lines else None),
-                   "checkpoint_ref": self.checkpoint_ref}
+                   "checkpoint_ref": self.checkpoint_ref,
+                   "xray": self._xray_cmd(batch)}
             with open(path, "w") as f:
                 json.dump(doc, f, **_J)
             self.dumps.append(path)
             return path
         except OSError:  # pragma: no cover - disk-full etc.
             return None
+
+    def _xray_cmd(self, batch: int) -> Optional[str]:
+        """The ready-to-run `kme-xray --bisect` line for the violating
+        window — pasted from the repro dump, it binary-searches the
+        journal-vs-oracle divergence that tripped this auditor."""
+        if not (self.journal_ref and self.log_ref):
+            return None
+        cmd = (f"kme-xray --bisect --journal {self.journal_ref} "
+               f"--log-dir {self.log_ref} --hi-batch {batch}")
+        if self.checkpoint_ref:
+            cmd += f" --checkpoint-dir {self.checkpoint_ref}"
+        return cmd
 
 
 def _dict_diff(a: dict, b: dict, limit: int = 4) -> str:
@@ -543,12 +560,10 @@ def load_repro(path: str) -> dict:
         return json.load(f)
 
 
-def replay_repro(path: str) -> List[Violation]:
-    """Offline replay of a repro dump: seed a fresh auditor with the
-    dumped pre-batch shadow state, re-apply the dumped events, return
-    the violations found — which must cover the dumped ones."""
-    doc = load_repro(path)
-    pre = doc["pre_state"]
+def auditor_from_pre(pre: dict) -> "InvariantAuditor":
+    """Fresh auditor seeded from a repro dump's `pre_state` snapshot
+    (the _snapshot wire shape). Shared by replay_repro and the xray
+    bisect repro replayer."""
     aud = InvariantAuditor()
     aud.balances = {int(k): v for k, v in pre["balances"].items()}
     aud.positions = {(int(a), int(s)): tuple(v)
@@ -562,5 +577,14 @@ def replay_repro(path: str) -> List[Violation]:
         book[0 if is_buy else 1].setdefault(px, []).append(oid)
     aud.inflow = pre["inflow"]
     aud._unbounded_credit = pre.get("unbounded_credit", False)
+    return aud
+
+
+def replay_repro(path: str) -> List[Violation]:
+    """Offline replay of a repro dump: seed a fresh auditor with the
+    dumped pre-batch shadow state, re-apply the dumped events, return
+    the violations found — which must cover the dumped ones."""
+    doc = load_repro(path)
+    aud = auditor_from_pre(doc["pre_state"])
     aud.observe(doc["events"])
     return aud.violations

@@ -557,7 +557,8 @@ def _burn_rate(counts: Sequence[int], threshold_s: float,
 
 def aggregate(snaps: Sequence[Tuple[str, dict]],
               slo_ms: Optional[float] = None,
-              slo_target: float = 0.999) -> dict:
+              slo_target: float = 0.999,
+              stale: Optional[dict] = None) -> dict:
     """The cluster SLO plane from N scraped /metrics.json snapshots
     (front + every group). Returns:
 
@@ -568,7 +569,11 @@ def aggregate(snaps: Sequence[Tuple[str, dict]],
     - "slo": global burn rate against (slo_ms, slo_target) when given;
     - "per_group": one row per source — e2e p99, input lag, overload
       state, shed count, imbalance gauges — degraded rows ("up": False)
-      for sources that could not be scraped;
+      for sources that could not be scraped; rows named in `stale`
+      (source -> {"age_s", "intervals", "sample_seq"}) additionally
+      carry "stale": True — scraped fine, but the heartbeat's
+      sample_seq/mtime has not advanced within 3 write intervals, so
+      the numbers describe a frozen writer, not the present;
     - "exemplars": the slowest-order exemplars across all sources,
       worst first (each resolves to a waterfall via
       `kme-trace --order AID:OID`)."""
@@ -594,6 +599,11 @@ def aggregate(snaps: Sequence[Tuple[str, dict]],
                "orders": (lats.get("lat_e2e") or {}).get("count", 0),
                "overload_state": g.get("overload_state"),
                "shed": g.get("overload_rejects", 0)}
+        if stale and name in stale:
+            row["stale"] = True
+            row["hb_age_s"] = stale[name].get("age_s")
+            row["hb_intervals"] = stale[name].get("intervals")
+            row["hb_sample_seq"] = stale[name].get("sample_seq")
         for k, v in g.items():
             if k.startswith("group") and (k.endswith("_lag")
                                           or k.endswith("_imbalance")):
@@ -664,9 +674,22 @@ def render_agg(doc: dict) -> str:
             continue
         extras = " ".join(
             f"{k}={row[k]}" for k in sorted(row)
-            if k not in ("source", "up", "e2e_p99_ms", "orders"))
+            if k not in ("source", "up", "e2e_p99_ms", "orders",
+                         "stale", "hb_age_s", "hb_intervals",
+                         "hb_sample_seq"))
+        mark = ""
+        if row.get("stale"):
+            bits = []
+            if row.get("hb_age_s") is not None:
+                bits.append(f"heartbeat {row['hb_age_s']:.1f}s old "
+                            f"({row.get('hb_intervals', 0):.1f} "
+                            f"intervals)")
+            if row.get("hb_sample_seq") is not None:
+                bits.append(f"sample_seq frozen at "
+                            f"{row['hb_sample_seq']}")
+            mark = f" ** STALE ({', '.join(bits) or 'frozen'}) **"
         lines.append(f"    {row['source']}: orders={row['orders']} "
-                     f"e2e_p99={row['e2e_p99_ms']}ms {extras}")
+                     f"e2e_p99={row['e2e_p99_ms']}ms {extras}{mark}")
     ex = doc.get("exemplars") or ()
     if ex:
         lines.append("  slowest orders (kme-trace --order AID:OID):")

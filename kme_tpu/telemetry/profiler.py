@@ -368,3 +368,59 @@ class TriggerCapture:
             return True
         except Exception:   # noqa: BLE001 — capture is best-effort
             return False
+
+
+# ---------------------------------------------------------------------------
+# capture reader (kme-prof --captures): TriggerCapture and xray
+# watchpoint captures share the capture_NNN.json namespace and doc shape
+
+
+def list_captures(dir_path: str) -> list:
+    """capture_NNN.json paths in a capture directory, index order."""
+    import re
+
+    pat = re.compile(r"^capture_(\d+)\.json$")
+    try:
+        names = os.listdir(dir_path)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        m = pat.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(dir_path, n)))
+    return [p for _i, p in sorted(out)]
+
+
+def format_capture(path: str) -> str:
+    """One capture doc as human-readable lines."""
+    with open(path) as f:
+        doc = json.load(f)
+    when = time.strftime("%Y-%m-%d %H:%M:%S",
+                         time.localtime(doc.get("time", 0)))
+    trig = doc.get("trigger", "?")
+    head = f"{os.path.basename(path)}  {when}  trigger={trig}"
+    if trig == "watchpoint":
+        head += (f"  predicate={doc.get('predicate')!r}"
+                 f"  offset={doc.get('offset')}"
+                 f"  value={doc.get('value')}")
+    elif trig == "slo_burn":
+        head += f"  reason={doc.get('reason')}"
+    elif trig == "p99_exemplar":
+        head += (f"  e2e_us={doc.get('e2e_us')}"
+                 f"  threshold_us={doc.get('threshold_us')}")
+    lines = [head]
+    for ex in doc.get("exemplars") or []:
+        lines.append(
+            f"  exemplar off={ex.get('off')} oid={ex.get('oid')} "
+            f"aid={ex.get('aid')} e2e_us={ex.get('e2e_us')} "
+            f"tid={ex.get('tid')}")
+    if doc.get("trace_events") is not None:
+        lines.append(f"  trace events: {len(doc['trace_events'])}")
+    if doc.get("jax_profile_dir"):
+        lines.append(f"  jax profile: {doc['jax_profile_dir']}")
+    if doc.get("repro"):
+        lines.append(f"  repro: {doc['repro']}")
+    if doc.get("resolve_with"):
+        lines.append(f"  resolve: {doc['resolve_with']}")
+    return "\n".join(lines)

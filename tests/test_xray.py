@@ -1,0 +1,392 @@
+"""Time-travel state inspection, divergence bisection and live
+watchpoints (telemetry/xray.py, kme-xray, ISSUE 17). Pins the
+contracts the x-ray plane stands on:
+
+- offset-addressed materialization is EXACT: nearest retained snapshot
+  + deterministic replay of the durable MatchIn log reproduces the
+  engine state at any retained offset, and targets below the replay
+  window fail with an error naming the oldest materializable offset;
+- divergence bisection is LOGARITHMIC and exact: the first journal
+  batch whose recorded effects diverge from a fresh oracle replay is
+  pinned in <= ceil(log2(window_batches)) + 1 replays (count
+  asserted), and the minimized repro replays to the same field diff
+  offline with no broker and no engine;
+- watchpoints are DETERMINISTIC and FREE: identical seeded runs fire
+  identical (offset, predicate, value) hit sets, MatchOut bytes are
+  identical with watchpoints armed or not, and every capture's
+  kme-xray one-liner re-fires offline;
+- a cluster cut is CONSISTENT: at any whole-line watermark of the
+  merged input, per-group cash + open margin + pending transfer
+  reserve byte-agrees with a single-leader replay of the same prefix.
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+from kme_tpu.bridge.broker import InProcessBroker
+from kme_tpu.bridge.provision import group_topics, provision
+from kme_tpu.bridge.service import TOPIC_IN, MatchService
+from kme_tpu.telemetry import xray
+from kme_tpu.telemetry.journal import read_events
+from kme_tpu.wire import dumps_order
+from kme_tpu.workload import cross_account_stream, harness_stream
+
+
+def _stream(n=600, seed=7):
+    return harness_stream(n, seed=seed, num_accounts=6, num_symbols=2,
+                          payout_opcode_bug=False, validate=True)
+
+
+def _serve(tmp_path, msgs, name, **kw):
+    """One in-process serve over a persisted broker log; returns
+    (svc, log_dir, matchout_values)."""
+    log_dir = str(tmp_path / name / "broker-log")
+    br = InProcessBroker(persist_dir=log_dir)
+    provision(br)
+    for m in msgs:
+        br.produce(TOPIC_IN, None, dumps_order(m))
+    svc = MatchService(br, engine="oracle", compat="fixed", **kw)
+    svc.run(max_messages=len(msgs))
+    svc.close()
+    out, off = [], 0
+    while True:
+        recs = br.fetch("MatchOut", off, 4096)
+        if not recs:
+            break
+        out.extend(r.value for r in recs)
+        off = recs[-1].offset + 1
+    return svc, log_dir, out
+
+
+# -- predicate grammar -------------------------------------------------
+
+
+def test_watch_grammar():
+    p = xray.parse_watch("balance[3]<0")
+    assert (p.kind, p.a, p.op, p.rhs) == ("balance", 3, "<", 0)
+    p = xray.parse_watch(" position[2,1] >= 10 ")
+    assert (p.kind, p.a, p.b, p.op, p.rhs) == ("position", 2, 1, ">=", 10)
+    p = xray.parse_watch("depth[1]!=-3")
+    assert (p.op, p.rhs) == ("!=", -3)
+    assert xray.parse_watch("spread[2]==0").kind == "spread"
+
+
+@pytest.mark.parametrize("bad", [
+    "balance[3]", "balance<0", "balance[a]<0", "position[1]<0",
+    "depth[1,2]<0", "balance[1]<-1e9", "volume[1]>0", "",
+    "balance[1]<0; import os"])
+def test_watch_grammar_rejects(bad):
+    with pytest.raises(xray.XrayError):
+        xray.parse_watch(bad)
+
+
+# -- materialization + replay window -----------------------------------
+
+
+def test_materialize_matches_live_state(tmp_path):
+    msgs = _stream()
+    ck = str(tmp_path / "ckpt")
+    svc, log_dir, _out = _serve(tmp_path, msgs, "m", batch=64,
+                                checkpoint_dir=ck, checkpoint_every=256)
+    want = xray.engine_canon(svc._oracle)
+    # anchored on a snapshot
+    eng, anchor, replayed = xray.materialize(log_dir, len(msgs),
+                                             ckpt_dir=ck)
+    assert anchor > 0 and replayed == len(msgs) - anchor
+    assert xray.engine_canon(eng) == want
+    # cold replay from offset 0 agrees byte for byte
+    eng2, anchor2, replayed2 = xray.materialize(log_dir, len(msgs),
+                                                allow_cold=True)
+    assert anchor2 == 0 and replayed2 <= len(msgs)
+    assert xray.engine_canon(eng2) == want
+
+
+def test_replay_window_floor(tmp_path):
+    """checkpoint-keep pruning moves the materialization floor: at or
+    above oldest_retained_offset succeeds, below fails with an error
+    naming the floor (the journal's rotate_keep guard releases history
+    below the oldest snapshot, so nothing there can be cross-checked).
+    """
+    from kme_tpu.runtime.checkpoint import oldest_retained_offset
+
+    msgs = _stream()
+    ck = str(tmp_path / "ckpt")
+    _svc, log_dir, _out = _serve(tmp_path, msgs, "w", batch=64,
+                                 checkpoint_dir=ck,
+                                 checkpoint_every=128,
+                                 checkpoint_keep=2)
+    floor = oldest_retained_offset(ck)
+    assert floor and floor > 0, "keep=2 should have pruned early snaps"
+    assert xray.oldest_materializable(ck) == floor
+    # at/above the floor: materializes fine
+    eng, anchor, _n = xray.materialize(log_dir, floor, ckpt_dir=ck)
+    assert anchor <= floor
+    # below: a clear error naming the oldest materializable offset
+    with pytest.raises(xray.XrayError) as ei:
+        xray.materialize(log_dir, floor - 1, ckpt_dir=ck)
+    msg = str(ei.value)
+    assert str(floor) in msg and "oldest materializable" in msg
+    assert "--checkpoint-keep" in msg and "rotate_keep" in msg
+    # the escape hatch: the broker log is never pruned, so a cold
+    # replay can still reach below the window on request
+    eng3, anchor3, _n3 = xray.materialize(log_dir, floor - 1,
+                                          ckpt_dir=ck, allow_cold=True)
+    assert anchor3 <= floor - 1
+
+
+def test_point_queries_and_trace_resolution(tmp_path):
+    msgs = _stream()
+    svc, log_dir, _out = _serve(tmp_path, msgs, "q", batch=64)
+    end = len(msgs)
+    eng, _a, _n = xray.materialize(log_dir, end, allow_cold=True)
+    # balance agrees with the live engine at the same watermark
+    for aid in (1, 2, 3):
+        assert eng.balances.get(aid) == svc._oracle.balances.get(aid)
+    # book summary derives the same depth/spread the grammar measures
+    bs = xray.book_summary(eng, 1)
+    assert bs["depth"] == xray.measure_engine(
+        xray.parse_watch("depth[1]>=0"), eng)
+    assert bs["spread"] == xray.measure_engine(
+        xray.parse_watch("spread[1]==0"), eng)
+    # trace-id resolution round-trips offset -> tid -> offset
+    from kme_tpu.telemetry.dtrace import local_tid
+
+    off = end // 2
+    tid = local_tid(0, off)
+    assert xray.resolve_trace(tid, log_dir) == off
+
+
+# -- watchpoints -------------------------------------------------------
+
+
+def test_watch_deterministic_hits_and_matchout_parity(tmp_path):
+    msgs = _stream()
+    watch = ["balance[1]<0", "depth[1]>=4", "spread[1]==0",
+             "position[2,1]>0"]
+    runs = []
+    for tag in ("a", "b"):
+        svc, _ld, out = _serve(
+            tmp_path, msgs, tag, batch=64, watch=watch,
+            capture_dir=str(tmp_path / tag / "cap"))
+        runs.append((list(svc.watch.hits), out,
+                     list(svc.watch.capture_paths)))
+    _svc, _ld, out_off = _serve(tmp_path, msgs, "off", batch=64)
+    (hits_a, out_a, caps_a), (hits_b, out_b, _caps_b) = runs
+    assert hits_a, "the seeded stream should trip at least one pred"
+    assert hits_a == hits_b, "hit sets must be identical across runs"
+    assert out_a == out_b == out_off, \
+        "watchpoints must never touch MatchOut bytes"
+    # captures carry the offset, the value and an offline repro line
+    assert caps_a
+    doc = json.loads(open(caps_a[0]).read())
+    assert doc["trigger"] == "watchpoint"
+    assert any(h[0] == doc["offset"] and h[1] == doc["predicate"]
+               for h in hits_a)
+    assert doc["repro"].startswith("kme-xray eval ")
+
+
+def test_watch_offline_refire(tmp_path):
+    """Every live hit re-fires offline: materialize at the captured
+    offset + 1 and evaluate the same predicate to the same value."""
+    msgs = _stream()
+    svc, log_dir, _out = _serve(
+        tmp_path, msgs, "r", batch=64,
+        watch=["depth[1]>=4"], capture_dir=str(tmp_path / "r" / "cap"))
+    assert svc.watch.hits
+    for off, expr, val in svc.watch.hits:
+        eng, _a, _n = xray.materialize(log_dir, off + 1,
+                                       allow_cold=True)
+        fired, got = xray.eval_engine(xray.parse_watch(expr), eng)
+        assert fired and got == val
+
+
+def test_watch_shadow_agrees_with_engine(tmp_path):
+    """The event-fed shadow path (what non-oracle engines use at the
+    barrier) fires the same hit set as the engine-backed path — both
+    read the same state machine at the same barriers."""
+    from kme_tpu.oracle import OracleEngine
+    from kme_tpu.wire import parse_order
+
+    msgs = _stream()
+    exprs = ["depth[1]>=4", "balance[1]<0", "spread[1]==0"]
+    shadow = xray.WatchEngine(exprs)
+    direct = xray.WatchEngine(exprs)
+    eng = OracleEngine("fixed")
+    groups, offs = [], []
+    for off, m in enumerate(msgs):
+        recs = eng.process(parse_order(dumps_order(m)))
+        groups.append([f"{r.key} {dumps_order(r.value)}"
+                       for r in recs])
+        offs.append(off)
+        if len(groups) == 64:     # the 64-message barrier cadence
+            shadow.observe_lines(groups, offsets=offs)
+            direct.observe_engine(eng, offs[-1])
+            groups, offs = [], []
+    if groups:
+        shadow.observe_lines(groups, offsets=offs)
+        direct.observe_engine(eng, offs[-1])
+    assert shadow.hits, "the seeded stream should trip a predicate"
+    assert shadow.hits == direct.hits
+    # and the shadow's final measurements agree with the engine's
+    for expr in exprs:
+        pred = xray.parse_watch(expr)
+        assert xray.measure(pred, shadow._shadow) == \
+            xray.measure_engine(pred, eng)
+
+
+# -- divergence bisection ----------------------------------------------
+
+
+def test_bisect_pins_exact_batch(tmp_path, monkeypatch):
+    """The CI drill: a journal-side fill-size tamper from batch K on.
+    Bisection pins batch K exactly, within the replay bound, and the
+    minimized repro replays to the same diff offline."""
+    msgs = harness_stream(2000, seed=3, num_accounts=8, num_symbols=3,
+                          payout_opcode_bug=False, validate=True)
+    ck = str(tmp_path / "ckpt")
+    jp = str(tmp_path / "journal.bin")
+    monkeypatch.setenv("KME_AUDIT_TAMPER", "journal_fill_qty@17")
+    svc, log_dir, _out = _serve(tmp_path, msgs, "b", batch=64,
+                                checkpoint_dir=ck,
+                                checkpoint_every=512, journal=jp)
+    monkeypatch.delenv("KME_AUDIT_TAMPER")
+    assert svc._tampered_batch == 17
+
+    res = xray.bisect(jp, log_dir, ckpt_dir=ck,
+                      repro_dir=str(tmp_path))
+    assert res["divergent"]
+    assert res["batch"] == 17, res
+    bound = math.ceil(math.log2(res["window_batches"])) + 1
+    assert res["replays"] <= bound, \
+        f"{res['replays']} replays > log2 bound {bound}"
+    assert res["diff"], "divergence must carry a field-level diff"
+    assert res["first_divergent_offset"] >= 0
+
+    # the repro dump replays offline to the SAME diff — no broker, no
+    # engine, just the dump
+    rep = xray.replay_bisect_repro(res["repro"])
+    assert rep["match"] and rep["batch"] == 17
+    # and names the ready-to-run bisect command (audit.py dump format)
+    doc = json.loads(open(res["repro"]).read())
+    assert "kme-xray --bisect" in doc["xray"]
+    assert doc["violations"][0]["kind"] == "bisect_divergence"
+
+
+def test_bisect_clean_journal_no_divergence(tmp_path):
+    msgs = _stream()
+    jp = str(tmp_path / "journal.bin")
+    _svc, log_dir, _out = _serve(tmp_path, msgs, "c", batch=64,
+                                 journal=jp)
+    res = xray.bisect(jp, log_dir)
+    assert not res["divergent"]
+    assert res["replays"] == 1   # the single hi-probe
+
+
+def test_audit_repro_names_xray_command(tmp_path, monkeypatch):
+    """Satellite 3: auditor repro dumps carry an `xray` key with the
+    ready-to-run bisect command for the journal that tripped."""
+    msgs = _stream()
+    jp = str(tmp_path / "journal.bin")
+    rd = str(tmp_path / "repro")
+    monkeypatch.setenv("KME_AUDIT_TAMPER", "journal_fill_qty@5")
+    svc, log_dir, _out = _serve(tmp_path, msgs, "a", batch=64,
+                                journal=jp, audit=True,
+                                audit_repro_dir=rd)
+    monkeypatch.delenv("KME_AUDIT_TAMPER")
+    assert svc.auditor.violations, "journal tamper must trip the audit"
+    dumps = sorted(os.listdir(rd))
+    assert dumps
+    doc = json.loads(open(os.path.join(rd, dumps[0])).read())
+    assert "xray" in doc and "--bisect" in doc["xray"]
+    assert jp in doc["xray"]
+    # the named command's journal/log refs point at real paths
+    assert os.path.exists(jp)
+
+
+# -- cluster cut -------------------------------------------------------
+
+
+def _grouped_cluster(tmp_path, ngroups=4, events=360, seed=11):
+    """A chaos-layout state root: front.in + per-group persisted
+    brokers, checkpoints and serves."""
+    from kme_tpu.bridge import front
+
+    lines = [dumps_order(m) for m in cross_account_stream(
+        events, 32 * ngroups, 8 * ngroups, ngroups, seed=seed,
+        cross_frac=1.0)]
+    root = tmp_path / "root"
+    root.mkdir()
+    (root / "front.in").write_text("".join(ln + "\n" for ln in lines))
+    per, _router = front.split_lines(lines, ngroups, transfers=True,
+                                     prefund=8)
+    for k in range(ngroups):
+        gdir = root / f"group{k}" / "state"
+        gdir.mkdir(parents=True)
+        t_in, _t_out, _t_x = group_topics(k)
+        br = InProcessBroker(persist_dir=str(gdir / "broker-log"))
+        provision(br, topics=group_topics(k))
+        for ln in per[k]:
+            br.produce(t_in, None, ln)
+        svc = MatchService(br, engine="oracle", compat="fixed",
+                           batch=64, group=(k, ngroups),
+                           checkpoint_dir=str(gdir),
+                           checkpoint_every=128)
+        svc.run(max_messages=len(per[k]))
+        svc.close()
+    return str(root), lines
+
+
+def test_cluster_cut_conserves_cash(tmp_path):
+    root, lines = _grouped_cluster(tmp_path)
+    # full watermark and an arbitrary mid-stream whole-line cut
+    for at in (None, len(lines) * 3 // 5):
+        rep = xray.cluster_cut(root, at=at)
+        assert rep["conserved"], rep["delta"]
+        assert rep["transfer_shortfalls"] == 0
+        assert rep["cluster"]["cash"] == rep["single_leader"]["cash"]
+        assert (rep["cluster"]["open_margin"]
+                == rep["single_leader"]["open_margin"])
+        assert len(rep["groups"]) == 4
+        if at is not None:
+            assert rep["watermark"] == at
+
+
+# -- capture reader (kme-prof --captures) ------------------------------
+
+
+def test_capture_reader_shared_format(tmp_path):
+    from kme_tpu.telemetry.profiler import format_capture, list_captures
+
+    msgs = _stream()
+    cap = str(tmp_path / "cap")
+    svc, _ld, _out = _serve(tmp_path, msgs, "cr", batch=64,
+                            watch=["depth[1]>=4"], capture_dir=cap)
+    assert svc.watch.capture_paths
+    paths = list_captures(cap)
+    assert paths == sorted(svc.watch.capture_paths)
+    text = format_capture(paths[0])
+    assert "watchpoint" in text and "depth[1]>=4" in text
+    assert "kme-xray eval" in text
+    # missing dir degrades to empty, not an exception
+    assert list_captures(str(tmp_path / "nope")) == []
+
+
+# -- kme-agg staleness -------------------------------------------------
+
+
+def test_aggregate_marks_stale_sources():
+    from kme_tpu.telemetry.dtrace import aggregate, render_agg
+
+    snap = {"counters": {}, "gauges": {}, "latencies": {}}
+    doc = aggregate([("fresh.hb", snap), ("stuck.hb", snap)],
+                    stale={"stuck.hb": {"age_s": 9.5, "intervals": 9.5,
+                                        "sample_seq": 42}})
+    rows = {r["source"]: r for r in doc["per_group"]}
+    assert rows["stuck.hb"]["stale"] is True
+    assert "stale" not in rows["fresh.hb"]
+    text = render_agg(doc)
+    assert text.count("STALE") == 1
+    assert "sample_seq frozen at 42" in text
