@@ -37,6 +37,10 @@ RULES: Dict[str, str] = {
     "KME-L001": "lock-order cycle in the static acquisition graph",
     "KME-L002": "attribute mutated from multiple threads without a "
                 "common lock",
+    "KME-C001": "direct wall-clock/sleep call (time.time/monotonic/"
+                "sleep/time_ns) in a clock-seamed sim-reachable "
+                "function — go through the injected bridge/clock.py "
+                "seam",
 }
 
 # -- scope tables -----------------------------------------------------------
@@ -191,6 +195,29 @@ FEED_SCOPES: Dict[str, Set[str]] = {
         "snapshot_frames"},
 }
 
+# Clock-seam scopes (KME-C001, ISSUE 19): functions the deterministic
+# whole-cluster simulator (kme_tpu/sim/) reaches while it owns time.
+# Each listed function received an injectable clock (bridge/clock.py)
+# and must keep every wait/stamp/interval read on that seam: one direct
+# ``time.sleep`` in a retry loop turns a reproducible seed into a
+# wall-clock race, and a direct ``time.time_ns`` admission stamp forks
+# the virtual-time latency attribution. ``perf_counter`` is deliberately
+# NOT flagged — host profiling durations are observability, not
+# behavior, and stay on the real clock by design (PROFILER_SCOPES
+# below documents the same boundary for the profiling plane).
+# ``run`` (service) is deliberately NOT listed: its serve.stuck /
+# stall-drill branches block the real process on purpose, and the sim
+# drives ``step()`` directly.
+CLOCK_SCOPES: Dict[str, Set[str]] = {
+    "kme_tpu/bridge/service.py": {
+        "step", "_step_pipelined", "_process_batch", "_produce_retry",
+        "_publish_batch", "_write_heartbeat"},
+    "kme_tpu/bridge/broker.py": {"produce", "fetch"},
+    "kme_tpu/bridge/replica.py": {"fetch", "run", "_write_heartbeat",
+                                  "_promote"},
+    "kme_tpu/bridge/tcp.py": {"_ats_for"},
+}
+
 # Profiler scopes (ISSUE 16): the continuous-profiling plane is
 # DELIBERATELY outside every table above, and this entry documents the
 # boundary so the exemption is a reviewed decision rather than an
@@ -235,6 +262,13 @@ _BLOCKING_METHOD_ATTRS = {"write", "flush", "fsync", "sendall",
 _WALLCLOCK = {("time", "time"), ("time", "time_ns"),
               ("time", "clock_gettime"), ("datetime", "now"),
               ("datetime", "utcnow"), ("datetime", "today")}
+# the clock-seam family adds the interval/wait primitives the replay
+# rule doesn't care about, and tolerates the repo's import aliases
+# (``import time as _t`` / ``as _time``) — an alias must not launder a
+# wall read past the seam
+_CLOCK_HEADS = {"time", "_time", "_t"}
+_CLOCK_TAILS = {"time", "time_ns", "clock_gettime", "monotonic",
+                "monotonic_ns", "sleep"}
 _RANDOM_MODULES = {"random", "secrets", "uuid"}
 _IMPLICIT_CTORS = {"zeros", "ones", "empty", "full", "arange",
                    "linspace", "array", "asarray", "fromiter"}
@@ -263,6 +297,7 @@ class _RuleVisitor(ast.NodeVisitor):
                            | TRACE_SCOPES.get(relpath, set())
                            | FEED_SCOPES.get(relpath, set())
                            | XRAY_SCOPES.get(relpath, set()))
+        self.clock_fns = CLOCK_SCOPES.get(relpath, set())
         self.traced = relpath.startswith(TRACED_DIRS)
 
     # -- bookkeeping ----------------------------------------------------
@@ -305,6 +340,8 @@ class _RuleVisitor(ast.NodeVisitor):
             self._check_hot_call(node, dotted, head, tail)
         if self._in(self.replay_fns):
             self._check_replay_call(node, dotted, head, tail)
+        if self._in(self.clock_fns):
+            self._check_clock_call(node, dotted, head, tail)
         if self.traced:
             self._visit_traced_call(node)
         self.generic_visit(node)
@@ -354,6 +391,19 @@ class _RuleVisitor(ast.NodeVisitor):
             self._emit("KME-D002", node,
                        f"nondeterminism source '{dotted}()' in a "
                        f"replay-affecting path")
+
+    def _check_clock_call(self, node, dotted, head, tail) -> None:
+        if head in _CLOCK_HEADS and tail in _CLOCK_TAILS:
+            self._emit("KME-C001", node,
+                       f"direct '{dotted}()' in a clock-seamed "
+                       f"function — the simulator owns time here; use "
+                       f"the injected clock (bridge/clock.py)")
+        elif dotted in ("datetime.datetime.now",
+                        "datetime.datetime.utcnow"):
+            self._emit("KME-C001", node,
+                       f"direct '{dotted}()' in a clock-seamed "
+                       f"function — use the injected clock "
+                       f"(bridge/clock.py)")
 
     # -- T family (engine/ops only) -------------------------------------
 

@@ -409,7 +409,14 @@ class InProcessBroker:
 
     def __init__(self, persist_dir: Optional[str] = None,
                  max_lag: Optional[int] = None,
-                 overload: Optional[OverloadController] = None) -> None:
+                 overload: Optional[OverloadController] = None,
+                 clock=None) -> None:
+        from kme_tpu.bridge.clock import WALL
+
+        # the clock seam (bridge/clock.py): admission stamps (``ats``)
+        # come off this object so a simulated broker stamps virtual
+        # microseconds deterministically
+        self._clock = clock or WALL
         self._topics: Dict[str, _Topic] = {}
         self._lock = threading.Lock()
         self._data = threading.Condition(self._lock)
@@ -590,9 +597,7 @@ class InProcessBroker:
             if shed_detail is None:
                 off = len(t.log)
                 if ats is None:
-                    import time as _time
-
-                    ats = _time.time_ns() // 1000
+                    ats = self._clock.time_us()
                 t.log.append(Record(off, key, value, epoch, out_seq,
                                     ats, tid))
                 if out_seq is not None:
@@ -667,7 +672,7 @@ class InProcessBroker:
         oid_col, aid_col = wb.oid, wb.aid
         parse_ns = _time.perf_counter_ns() - t0
         if ats is None:
-            ats = _time.time_ns() // 1000
+            ats = self._clock.time_us()
         appended, last_off = 0, -1
         shed_detail = overload_msg = None
         with self._data:
@@ -779,10 +784,8 @@ class InProcessBroker:
             recs = t.log[offset:offset + max_records]
         obs = self.deliver_observer
         if obs is not None and recs:
-            import time as _time
-
             try:
-                obs(topic, recs, _time.time_ns() // 1000)
+                obs(topic, recs, self._clock.time_us())
             except Exception:
                 pass        # observability must never fail a fetch
         return recs

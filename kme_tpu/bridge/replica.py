@@ -46,7 +46,6 @@ import contextlib
 import json
 import os
 import sys
-import time
 from typing import List, Optional
 
 from kme_tpu import faults
@@ -70,7 +69,11 @@ class _FollowBroker:
     the tail cursor entirely.
     """
 
-    def __init__(self, log_dir: str, topic: str = TOPIC_IN) -> None:
+    def __init__(self, log_dir: str, topic: str = TOPIC_IN,
+                 clock=None) -> None:
+        from kme_tpu.bridge.clock import WALL
+
+        self._clock = clock or WALL
         self._path = os.path.join(log_dir, f"{topic}.log")
         self._topic = topic
         self._recs: List[Record] = []
@@ -116,7 +119,7 @@ class _FollowBroker:
         end = min(len(self._recs), self.limit, offset + max_records)
         recs = self._recs[offset:end]
         if not recs and timeout > 0:
-            time.sleep(min(timeout, 0.1))
+            self._clock.sleep(min(timeout, 0.1))
         return recs
 
     def end_offset(self, topic: str) -> int:
@@ -149,7 +152,13 @@ class Replica:
                  metrics_port: Optional[int] = None,
                  group=None, journal_out: Optional[str] = None,
                  trace_spans: bool = False,
-                 tsdb: Optional[str] = None) -> None:
+                 tsdb: Optional[str] = None, clock=None) -> None:
+        from kme_tpu.bridge.clock import WALL
+
+        # the clock seam (bridge/clock.py): the follow loop's poll
+        # cadence, heartbeat gating and promotion deadline all run off
+        # this object so a simulated standby never blocks real time
+        self.clock = clock or WALL
         self.group = group
         # armed at PROMOTION only: a follower's output is discarded, so
         # journaling its stages would double-record every offset the
@@ -177,7 +186,8 @@ class Replica:
         if group is not None and group[1] > 1:
             # shard-group mode: follow the group's namespaced input log
             topic_in = f"{TOPIC_IN}.g{group[0]}"
-        self.follow = _FollowBroker(self.log_dir, topic=topic_in)
+        self.follow = _FollowBroker(self.log_dir, topic=topic_in,
+                                    clock=self.clock)
         self.svc = MatchService(
             self.follow, engine=engine, compat=compat, batch=batch,
             symbols=symbols, accounts=accounts, slots=slots,
@@ -185,7 +195,8 @@ class Replica:
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
             checkpoint_keep=checkpoint_keep,
-            exactly_once=True, follower=True, group=group)
+            exactly_once=True, follower=True, group=group,
+            clock=self.clock)
         self.tsdb = None
         self._tsdb_dir = tsdb
         if tsdb is not None:
@@ -260,7 +271,8 @@ class Replica:
         tmp = self.health_file + ".tmp"
         try:
             with open(tmp, "w") as f:
-                json.dump({"pid": os.getpid(), "time": time.time(),
+                json.dump({"pid": os.getpid(),
+                           "time": self.clock.time(),
                            "role": "standby", "applied": applied,
                            "tick": tick,
                            "out_seq": self.svc.out_seq,
@@ -295,8 +307,8 @@ class Replica:
             if n and faults.should("standby.lag", offset=svc.offset):
                 print(f"kme-faults: standby stalled at offset "
                       f"{svc.offset}", file=sys.stderr)
-                time.sleep(1.0)
-            now = time.monotonic()
+                self.clock.sleep(1.0)
+            now = self.clock.monotonic()
             if now - last_hb >= self.health_every:
                 last_hb = now
                 lead = self._leader_offset()
@@ -339,7 +351,7 @@ class Replica:
                                   and self.group[1] > 1 else None))
         # ^ idempotent; logs already reloaded
         host, port = parse_addr(self.listen)
-        deadline = time.monotonic() + 10.0
+        deadline = self.clock.monotonic() + 10.0
         while True:
             try:
                 # the dead leader's socket may linger in TIME_WAIT for
@@ -347,9 +359,9 @@ class Replica:
                 srv, broker = serve_broker(host, port, broker)
                 break
             except OSError:
-                if time.monotonic() >= deadline:
+                if self.clock.monotonic() >= deadline:
                     raise
-                time.sleep(0.1)
+                self.clock.sleep(0.1)
         svc.broker = broker
         svc.follower = False
         svc._init_exactly_once(resumed=False)   # next epoch + fence
@@ -367,7 +379,7 @@ class Replica:
         failover = None
         try:
             failed_at = float(promote["failed_at"])
-            failover = round(max(0.0, time.time() - failed_at), 3)
+            failover = round(max(0.0, self.clock.time() - failed_at), 3)
             svc.telemetry.gauge("failover_seconds").set(failover)
         except (KeyError, TypeError, ValueError):
             pass

@@ -74,7 +74,7 @@ class MatchService:
                  profile_artifact: Optional[str] = None,
                  capture_dir: Optional[str] = None,
                  capture_p99_us: Optional[int] = None,
-                 watch=None) -> None:
+                 watch=None, clock=None) -> None:
         if engine not in ("lanes", "seq", "oracle", "native"):
             raise ValueError(f"unknown engine {engine!r}")
         if compat not in ("java", "fixed"):
@@ -87,6 +87,13 @@ class MatchService:
         # form (runtime/javasnap.py) since round 5 — no engine/compat
         # combination is excluded from durability
         self.broker = broker
+        # the clock seam (bridge/clock.py): every sleep/backoff and
+        # interval read below goes through this object so the simulator
+        # can own time; production passes None and pays one attribute
+        # hop to the shared WallClock
+        from kme_tpu.bridge.clock import WALL
+
+        self.clock = clock or WALL
         # multi-leader shard group (ISSUE 9): group=(k, n) namespaces
         # every durable artifact this service touches on the broker —
         # its input/output topics become "MatchIn.g{k}"/"MatchOut.g{k}"
@@ -608,7 +615,8 @@ class MatchService:
         if failed_at:
             try:
                 self.telemetry.gauge("recovery_seconds").set(
-                    round(max(0.0, time.time() - float(failed_at)), 3))
+                    round(max(0.0, self.clock.time() - float(failed_at)),
+                          3))
             except ValueError:
                 pass
         self._init_latency()
@@ -977,9 +985,7 @@ class MatchService:
         except BrokerError:
             # topics not provisioned yet — keep polling, like a Streams
             # app waiting for its source topic
-            import time
-
-            time.sleep(min(timeout, 0.05))
+            self.clock.sleep(min(timeout, 0.05))
             return 0
         if not recs:
             return 0
@@ -992,7 +998,7 @@ class MatchService:
         with malformed or out-of-envelope records)."""
         import time as _t
 
-        fetch_us = _t.time_ns() // 1000
+        fetch_us = self.clock.time_us()
         lat = self._lat
         msgs, offs, drops, atss = [], [], [], []
         for r in recs:
@@ -1056,7 +1062,7 @@ class MatchService:
         # -- latency attribution: charge the batch's stage wall times to
         # every order in it (per-order quantiles), e2e from each
         # record's own admission stamp
-        done_us = _t.time_ns() // 1000
+        done_us = self.clock.time_us()
         n = len(msgs)
         plan_d = dev_d = 0.0
         if n:
@@ -1179,9 +1185,7 @@ class MatchService:
             recs = self.broker.fetch(self.topic_in, fetch_off, self.batch,
                                      timeout=timeout)
         except BrokerError:
-            import time
-
-            time.sleep(min(timeout, 0.05))
+            self.clock.sleep(min(timeout, 0.05))
             return 0
         if not recs:
             # idle input: finish the in-flight window so output
@@ -1196,7 +1200,7 @@ class MatchService:
             # batch through the exact per-record path (drops, strict)
             self._drain_pipeline()
             return self._process_batch(recs)
-        fetch_us = _t.time_ns() // 1000
+        fetch_us = self.clock.time_us()
         lat = self._lat
         atss = []
         for r in recs:
@@ -1249,7 +1253,7 @@ class MatchService:
         # point of the pipeline)
         dev_d = phases.get("fetch_s", 0.0) - p0.get("fetch_s", 0.0)
         self._produce_buffer(buf, line_off, ordinal)
-        done_us = _t.time_ns() // 1000
+        done_us = self.clock.time_us()
         n = wb.n
         if plan_d > 0:
             lat["plan"].observe(plan_d, n)
@@ -1340,8 +1344,6 @@ class MatchService:
         Runs on the POLL THREAD only: the engine refresh touches device
         arrays, which the heartbeat/HTTP threads must never do — they
         read registry snapshots."""
-        import time
-
         t = self.telemetry
         t.counter("service_batches").inc()
         t.counter("service_records").inc(nrecs)
@@ -1423,7 +1425,7 @@ class MatchService:
         if self._pipe is not None:
             t.gauge("pipeline_depth",
                     "in-flight pipelined batches").set(len(self._pipe))
-        now = time.monotonic()
+        now = self.clock.monotonic()
         if now - self._last_engine_pub >= 1.0:
             self._last_engine_pub = now
             if self._session is not None:
@@ -1502,8 +1504,6 @@ class MatchService:
         for promotion). BrokerFenced is never retried — a newer leader
         owns the stream and this process must die so its supervisor
         restarts it under a fresh epoch."""
-        import time
-
         from kme_tpu.bridge.broker import BrokerError, BrokerFenced
 
         stamped = stamp and self.epoch is not None
@@ -1530,7 +1530,7 @@ class MatchService:
                 print(f"kme-serve: produce to {topic} failed ({e}); "
                       f"retry {attempt + 1}/5 in {delay:.2f}s",
                       file=sys.stderr)
-                time.sleep(delay)
+                self.clock.sleep(delay)
                 delay = min(delay * 2, 1.0)
 
     def _produce_out(self, key, value) -> None:
@@ -1742,7 +1742,7 @@ class MatchService:
             t = threading.Thread(target=beater, daemon=True)
             t.start()
         try:
-            idle_since = time.monotonic()
+            idle_since = self.clock.monotonic()
             while max_messages is None or seen < max_messages:
                 n = self.step(timeout=poll_timeout)
                 if beat_stop is not None:
@@ -1751,7 +1751,7 @@ class MatchService:
                     # signal (the mtime alone only proves the beater
                     # thread lives)
                     tick_box[0] += 1
-                now = time.monotonic()
+                now = self.clock.monotonic()
                 if n == 0:
                     if idle_exit is not None \
                             and now - idle_since >= idle_exit:
@@ -1794,7 +1794,6 @@ class MatchService:
                          tick: int = 0, closing: bool = False) -> None:
         import json
         import os
-        import time as _t
 
         # refresh broker-side exactly-once counters HERE, not only on
         # the batch path: the final heartbeat after run() drains must
@@ -1821,7 +1820,7 @@ class MatchService:
             # purpose (idle-exit / max-messages): the tick is frozen by
             # definition, so the stall detector must stand down while
             # the final checkpoint + teardown run.
-            json.dump({"pid": os.getpid(), "time": _t.time(),
+            json.dump({"pid": os.getpid(), "time": self.clock.time(),
                        "seen": seen, "offset": self.offset,
                        "tick": tick, "closing": closing,
                        "degraded": self.degraded or self._slo_reason,

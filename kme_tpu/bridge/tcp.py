@@ -315,7 +315,13 @@ class TcpBroker:
     server-side wait (`timeout_ms`) so a long poll is never misread as
     a transport fault."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 clock=None) -> None:
+        from kme_tpu.bridge.clock import WALL
+
+        # the clock seam (bridge/clock.py): admission re-stamping of
+        # retried produces reads this object, never the wall directly
+        self._clock = clock or WALL
         self._addr = (host, port)
         self._timeout = timeout
         self._lock = threading.Lock()
@@ -410,11 +416,10 @@ class TcpBroker:
     def _ats_for(self, fp: tuple) -> int:
         """Admission stamp for a produce attempt: reuse the stamp of a
         transport-faulted attempt at the SAME record, else stamp now."""
-        import time as _time
         pend = self._pending
         if pend is not None and pend[0] == fp:
             return pend[1]
-        return _time.time_ns() // 1000
+        return self._clock.time_us()
 
     def create_topic(self, name: str, partitions: int = 1) -> bool:
         return self._call({"op": "create_topic", "topic": name,

@@ -1221,12 +1221,20 @@ def lint_main(argv=None) -> int:
     return _main(argv)
 
 
+def sim_main(argv=None) -> int:
+    """Deterministic whole-cluster simulation: seeded virtual-clock
+    runs, seed sweeps, shrinking repros (kme_tpu/sim/)."""
+    from kme_tpu.sim.cli import sim_main as _main
+
+    return _main(argv)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m kme_tpu.cli")
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
         "supervise", "standby", "trace", "chaos", "top", "lint",
-        "front", "agg", "feed", "reshard", "prof", "xray"))
+        "front", "agg", "feed", "reshard", "prof", "xray", "sim"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
@@ -1238,7 +1246,7 @@ def main(argv=None) -> int:
             "top": top_main, "lint": lint_main, "front": front_main,
             "agg": agg_main, "feed": feed_main,
             "reshard": reshard_main, "prof": prof_main,
-            "xray": xray_main,
+            "xray": xray_main, "sim": sim_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

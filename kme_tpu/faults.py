@@ -23,6 +23,9 @@ Spec grammar — ';'-separated clauses. ``seed=N`` seeds every rule's RNG
              (kill/stall points pass the service input offset)
     frac=F   for *.torn points: keep this fraction of the file
              (default 0.5)
+    ms=K     magnitude in milliseconds for the net.*/clock.* points
+             (partition window, added delivery delay, wall skew;
+             default 50)
 
 Known injection points (the call sites document themselves; grep for
 ``faults.``):
@@ -45,6 +48,18 @@ Known injection points (the call sites document themselves; grep for
                      detect it and die fenced, never write
     standby.lag      stall the hot-standby follower mid-tail (the
                      promotion path must absorb the catch-up)
+    net.partition    sim transport: sever the front->group link for
+                     `ms` virtual milliseconds (deliveries queue FIFO
+                     and flush on heal — never drop)
+    net.delay        sim transport: add `ms` virtual milliseconds to
+                     one delivery (the whole link shifts behind it;
+                     per-link FIFO order is preserved, like TCP)
+    net.reorder      sim transport: re-send an EARLIER stamped record
+                     after newer ones (an out-of-order duplicate
+                     produce — the broker's idempotence watermark must
+                     swallow it)
+    clock.skew       sim: step one actor's wall clock by `ms` (stamps
+                     shift; monotonic intervals don't, like NTP)
 
 Cross-process accounting: under a supervisor, a restarted child re-reads
 the same KME_FAULTS — an ``n``-limited rule must not refire every
@@ -70,7 +85,8 @@ ENV_STATE = "KME_FAULTS_STATE"
 
 _POINTS = ("broker.produce", "broker.fetch", "tcp.partial",
            "tcp.disconnect", "ckpt.torn", "ckpt.bitflip", "journal.torn",
-           "serve.kill", "serve.stuck", "lease.steal", "standby.lag")
+           "serve.kill", "serve.stuck", "lease.steal", "standby.lag",
+           "net.partition", "net.delay", "net.reorder", "clock.skew")
 
 
 class FaultSpecError(ValueError):
@@ -78,12 +94,12 @@ class FaultSpecError(ValueError):
 
 
 class Rule:
-    __slots__ = ("idx", "point", "p", "n", "after", "at", "frac",
+    __slots__ = ("idx", "point", "p", "n", "after", "at", "frac", "ms",
                  "hits", "fires", "rng")
 
     def __init__(self, idx: int, point: str, seed: int, p: float = 1.0,
                  n: int = 1, after: int = 0, at: Optional[int] = None,
-                 frac: float = 0.5) -> None:
+                 frac: float = 0.5, ms: int = 50) -> None:
         self.idx = idx
         self.point = point
         self.p = p
@@ -91,6 +107,7 @@ class Rule:
         self.after = after
         self.at = at
         self.frac = frac
+        self.ms = ms
         self.hits = 0           # eligible call-site visits (per process)
         self.fires = 0          # fires (per process)
         # one independent deterministic stream per rule: stable across
@@ -106,6 +123,8 @@ class Rule:
             bits.append(f"after={self.after}")
         if self.at is not None:
             bits.append(f"at={self.at}")
+        if self.ms != 50:
+            bits.append(f"ms={self.ms}")
         return ":".join(bits)
 
 
@@ -136,7 +155,7 @@ class FaultPlan:
                 if not sep:
                     raise FaultSpecError(f"bad fault field {f!r} in "
                                          f"{clause!r} (want key=value)")
-                if k in ("n", "after", "at"):
+                if k in ("n", "after", "at", "ms"):
                     kwargs[k] = int(v)
                 elif k in ("p", "frac"):
                     kwargs[k] = float(v)
@@ -257,6 +276,19 @@ def should(point: str, offset: Optional[int] = None) -> bool:
     """True iff `point` fires now (counts the fire)."""
     plan = _get_plan()
     return plan is not None and plan.fire(point, offset) is not None
+
+
+def fire(point: str, offset: Optional[int] = None) -> Optional[Rule]:
+    """Like ``should`` but returns the fired Rule, so parameterized
+    call sites (the sim transport's ``ms`` windows, ``frac`` damage)
+    can read the rule's knobs."""
+    plan = _get_plan()
+    return plan.fire(point, offset) if plan is not None else None
+
+
+def points() -> tuple:
+    """The known injection-point names (docs / schedule generators)."""
+    return _POINTS
 
 
 def fired_total() -> int:

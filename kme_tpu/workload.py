@@ -31,7 +31,8 @@ from __future__ import annotations
 import bisect
 import math
 import random
-from typing import Callable, Iterator, List, NamedTuple, Tuple
+from typing import (Callable, Iterator, List, NamedTuple, Sequence,
+                    Tuple)
 
 from kme_tpu import opcodes as op
 from kme_tpu.wire import OrderMsg
@@ -753,3 +754,48 @@ def storm_windows(name: str, num_events: int, num_symbols: int = None,
     return p.windows(num_events,
                      p.symbols if num_symbols is None else num_symbols,
                      p.accounts if num_accounts is None else num_accounts)
+
+
+def spliced_stream(num_events: int, seed: int = 0,
+                   splices: Sequence[Tuple[int, str, int]] = (),
+                   num_accounts: int = 10,
+                   num_symbols: int = 3,
+                   prefund_cash: int = 0) -> List[OrderMsg]:
+    """Generative scenario composition (kme-sim, kme_tpu/sim/): the
+    reference harness baseline with named storm bursts spliced in at
+    stream positions. `splices` is [(at, profile, n), ...] — insert an
+    `n`-event `profile` burst (STORM_PROFILES) before baseline position
+    `at`. Bursts keep their registry symbol/account spaces, so a spliced
+    storm brings its own preamble and collides with the baseline's id
+    space only where the registry says it does; everything stays a pure
+    function of (num_events, seed, splices), which is what lets a
+    shrunk fault schedule regenerate its input byte-identically.
+
+    `prefund_cash` > 0 prepends a CREATE_BALANCE + TRANSFER(cash) pair
+    for every account the composed stream can touch (baseline space ∪
+    spliced profiles' registry spaces). Grouped serving's parity
+    contract requires the funded envelope — the front's shadow-cash
+    margin bound is a conservative LOWER bound that never models
+    releases, so a depleted account can see a cross-shard grant fall
+    short and the group engine reject what the single oracle accepts
+    (`transfer_shortfall_total`; test_front pins shortfall == 0 for
+    exactly this reason). The deposits ride IN the stream, seen
+    identically by the oracle and the cluster."""
+    base = harness_stream(num_events, seed=seed,
+                          num_accounts=num_accounts,
+                          num_symbols=num_symbols)
+    # apply back-to-front so earlier positions stay valid
+    for at, name, n in sorted(splices, key=lambda s: s[0], reverse=True):
+        burst = storm_stream(name, n, seed=seed ^ 0x5EED)
+        at = max(0, min(len(base), int(at)))
+        base[at:at] = burst
+    if prefund_cash > 0:
+        space = max([num_accounts]
+                    + [STORM_PROFILES[name].accounts
+                       for _, name, _ in splices])
+        base[0:0] = [m for aid in range(space)
+                     for m in (OrderMsg(action=op.CREATE_BALANCE,
+                                        aid=aid),
+                               OrderMsg(action=op.TRANSFER, aid=aid,
+                                        size=int(prefund_cash)))]
+    return base

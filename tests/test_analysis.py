@@ -60,8 +60,19 @@ class Broker:
 """, """
 import time
 class Broker:
-    def produce(self, name, recs):
+    def _segment_stats(self):
         stamp = time.time()
+"""),
+    ("KME-C001", "kme_tpu/bridge/broker.py", """
+import time
+class Broker:
+    def fetch(self, name, offset):
+        t0 = time.monotonic()
+""", """
+import time
+class Broker:
+    def _segment_stats(self):
+        t0 = time.monotonic()
 """),
     ("KME-D002", "kme_tpu/telemetry/journal.py", """
 import random

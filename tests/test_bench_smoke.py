@@ -9,6 +9,8 @@ backend, plus the capacity-envelope policy that replaced the sticky
 overflow errors.
 """
 
+import pytest
+
 from kme_tpu.benchmarks import bench_lane_engine
 from kme_tpu.engine.lanes import LaneConfig
 from kme_tpu.oracle import OracleEngine
@@ -152,6 +154,7 @@ def test_capacity_envelope_zipf_stream_parity(cpu_devices):
     assert any(ln.startswith('OUT {"action":7') for ln in flat)
 
 
+@pytest.mark.slow
 def test_bench_seq_engine_smoke(cpu_devices, monkeypatch):
     """The r5 seq bench path at small scale: bytes-in parse, device-path
     measurement, FULL-stream parity vs the judge, local_orders_per_sec,
@@ -171,6 +174,7 @@ def test_bench_seq_engine_smoke(cpu_devices, monkeypatch):
                 "recon_s")) <= set(d)
 
 
+@pytest.mark.slow
 def test_bench_seq_java_smoke(cpu_devices, monkeypatch):
     """Java-mode seq bench: full-stream parity vs the java judge on the
     stock harness shape (VMEM-resident deep books at 8 lanes)."""

@@ -366,6 +366,7 @@ def _judge_java(msgs):
     return judge.process_wire([m.copy() for m in msgs])
 
 
+@pytest.mark.slow
 def test_seqjava_checkpoint_mid_stream_resume(cpu_devices, tmp_path):
     """Kill/resume mid-stream: process a prefix on a java-mode
     SeqSession, snapshot, restore into a FRESH session, continue — the

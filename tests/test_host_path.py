@@ -89,8 +89,11 @@ def test_pipelined_service_byte_parity_and_gauges():
             for name in ("plan_s", "recon_s", "host_path_s",
                          "pipeline_depth"):
                 assert name in g, name
+            # host_path_s is round(plan+recon, 6) while the addends are
+            # rounded separately — the two roundings can disagree by up
+            # to 1.5e-6, so the tolerance must sit above that
             assert g["host_path_s"] == pytest.approx(
-                g["plan_s"] + g["recon_s"], abs=1e-6)
+                g["plan_s"] + g["recon_s"], abs=2e-6)
             assert g["pipeline_depth"] == 0  # drained at run() exit
         svc.close()
         outs.append(list(consume_lines(broker, follow=False)))

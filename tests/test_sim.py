@@ -1,0 +1,385 @@
+"""Deterministic whole-cluster simulation (kme_tpu/sim/).
+
+Pins the simulation's own contracts — the ones every nightly seed
+sweep stands on:
+
+- one seed fully determines a run: byte-identical event-trace and
+  MatchOut digests across re-runs, divergent digests across seeds;
+- the virtual clock and seeded scheduler are the only time/ordering
+  sources (SimClockView, sleep charging, insertion-order tie-breaks);
+- schedule generation draws offset gates that can actually fire
+  (`after=` for the offset-less broker./ckpt. call sites, `at=` for
+  the net./clock sites) and reshard targets that keep grouped topic
+  namespacing valid;
+- the transport delivers strictly in stamp order across crash windows
+  (the FIFO-vs-restart bug class: later stamps must never advance the
+  broker watermark past parked earlier ones — silent input loss);
+- a calm run, a crash-recovery run and a mid-run reshard are all
+  green under the full verdict set;
+- the planted stamp-reset bug is found by a sweep, shrinks to a
+  minimal schedule (a single crash), and the written repro replays
+  red offline.
+"""
+
+import json
+import os
+
+import pytest
+
+from kme_tpu.sim.sched import SimClockView, SimScheduler
+from kme_tpu.sim.schedule import (SIM_POINTS, SIM_STORMS, FaultSchedule,
+                                  generate_schedule)
+from kme_tpu.sim.cluster import PLANTED_BUGS, SimConfig, run_sim
+from kme_tpu.sim.transport import SimTransport
+
+
+# ---------------------------------------------------------------------------
+# scheduler + clock units
+
+
+def test_virtual_clock_view_shares_now_with_private_skew():
+    sched = SimScheduler(seed=1)
+    a, b = SimClockView(sched), SimClockView(sched)
+    sched.now = 5.0
+    a.skew = 0.25
+    assert a.time() == 5.25 and b.time() == 5.0
+    assert a.monotonic() == 5.0     # skew never touches monotonic
+    assert a.time_ns() == int(5.25e9)
+
+
+def test_virtual_sleep_charges_scheduler_not_wall_clock():
+    sched = SimScheduler(seed=1)
+    view = SimClockView(sched)
+    view.sleep(3.0)
+    assert sched.sleep_charge == 3.0
+    assert sched.now == 0.0         # nothing blocked, nothing advanced
+
+
+def test_scheduler_same_seed_same_interleaving():
+    def run(seed):
+        sched = SimScheduler(seed=seed)
+
+        class A:
+            def __init__(self, name):
+                self.name, self.n, self.stopped = name, 0, False
+
+            def step(self):
+                self.n += 1
+                sched.trace(self.name, "step", n=self.n)
+                if self.n >= 5:
+                    self.stopped = True
+                return True
+
+        for name in ("x", "y", "z"):
+            sched.add_actor(name, A(name))
+        sched.run(until=lambda: False, max_vtime=10.0)
+        return sched.digest()
+
+    assert run(7) == run(7)
+    assert run(7) != run(8)
+
+
+def test_scheduler_tie_break_is_insertion_order():
+    sched = SimScheduler(seed=1)
+    seen = []
+    for i in range(5):
+        sched.post(1.0, lambda i=i: seen.append(i))
+    sched.run(until=lambda: False, max_vtime=10.0)
+    assert seen == [0, 1, 2, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# schedule generation
+
+
+def test_generate_schedule_is_deterministic_and_serializable():
+    a = generate_schedule(42, num_events=200)
+    b = generate_schedule(42, num_events=200)
+    assert a.to_json() == b.to_json()
+    assert generate_schedule(43, num_events=200).to_json() != a.to_json()
+    rt = FaultSchedule.from_json(a.to_json())
+    assert rt.to_json() == a.to_json()
+
+
+def test_generated_gates_can_actually_fire():
+    """broker./ckpt. call sites pass no offset to faults.fire, so an
+    `at=` gate there would silently never trigger — the generator must
+    use hit-count (`after=`) gates for them."""
+    for seed in range(60):
+        s = generate_schedule(seed, num_events=100)
+        for clause in s.clauses:
+            point = clause.split(":", 1)[0]
+            assert point in SIM_POINTS
+            if point.startswith(("broker.", "ckpt.")):
+                assert "after=" in clause and "at=" not in clause, clause
+            else:
+                assert "at=" in clause, clause
+        for ev in s.events:
+            if ev["kind"] == "reshard":
+                assert ev["to"] in (2, 3, 4) and ev["to"] != s.ngroups
+            if ev["kind"] == "storm":
+                assert ev["profile"] in SIM_STORMS
+
+
+def test_schedule_spec_prefixes_grammar_seed():
+    s = FaultSchedule(seed=9, clauses=["broker.produce:n=1:after=3"])
+    assert s.spec() == "seed=9;broker.produce:n=1:after=3"
+    assert FaultSchedule(seed=9).spec() is None
+
+
+# ---------------------------------------------------------------------------
+# transport: stamp-ordered delivery across a crash window
+
+
+def test_transport_fifo_survives_crash_window(tmp_path):
+    from kme_tpu.bridge.broker import InProcessBroker
+    from kme_tpu.bridge.provision import provision
+
+    sched = SimScheduler(seed=3)
+    view = SimClockView(sched)
+    broker = InProcessBroker(persist_dir=str(tmp_path / "log"),
+                             clock=view)
+    provision(broker, topics=("MatchIn.g0",))
+    up = [True]
+    t = SimTransport(sched, 1,
+                     broker_for=lambda g: broker if up[0] else None,
+                     topic_for=lambda g: "MatchIn.g0")
+
+    def feeder():
+        # 30 sends; the "leader" dies under the middle third, so those
+        # deliveries park while later ones keep arriving
+        for i in range(30):
+            t.send(0, None, f"rec{i}")
+
+    sched.post(0.0, feeder)
+    sched.post(0.003, lambda: up.__setitem__(0, False))
+
+    def restart():
+        up[0] = True
+        t.flush_held(0)
+
+    sched.post(0.010, restart)
+    sched.run(until=lambda: False, max_vtime=5.0)
+
+    recs = broker.fetch("MatchIn.g0", 0, 10 ** 6)
+    assert [r.value for r in recs] == [f"rec{i}" for i in range(30)]
+    assert [r.out_seq for r in recs] == list(range(30))
+    assert broker.dup_suppressed == 0       # no input loss, no dups
+    assert t.idle()
+
+
+def test_transport_reshape_resumes_cursors():
+    sched = SimScheduler(seed=3)
+    t = SimTransport(sched, 2, broker_for=lambda g: None,
+                     topic_for=lambda g: f"MatchIn.g{g}")
+    t.reshape(3, cursors=[5, 0, 7])
+    assert [l.seq for l in t.links] == [5, 0, 7]
+    assert [l.next_deliver for l in t.links] == [5, 0, 7]
+
+
+# ---------------------------------------------------------------------------
+# whole-cluster runs (small workloads: tier-1 budget)
+
+
+def _calm(seed, num_events=40, **kw):
+    return FaultSchedule(seed=seed, num_events=num_events, **kw)
+
+
+def test_sim_calm_run_is_green(tmp_path):
+    res = run_sim(_calm(3), str(tmp_path))
+    assert res.ok, res.verdicts
+    assert res.red_verdicts() == []
+    assert res.counters["crashes"] == 0
+    assert res.counters["delivered"] > 0
+
+
+def test_sim_same_seed_byte_identical_digests(tmp_path):
+    sched = FaultSchedule(
+        seed=11, num_events=40,
+        clauses=["net.delay:n=1:at=9:ms=50"],
+        events=[{"kind": "crash", "group": 0, "at": 25}])
+    a = run_sim(sched, str(tmp_path / "a"))
+    b = run_sim(sched, str(tmp_path / "b"))
+    assert a.trace_digest == b.trace_digest
+    assert a.out_digest == b.out_digest
+    assert a.ok and b.ok and a.counters == b.counters
+
+
+def test_sim_different_seeds_diverge(tmp_path):
+    a = run_sim(_calm(21), str(tmp_path / "a"))
+    b = run_sim(_calm(22), str(tmp_path / "b"))
+    assert a.trace_digest != b.trace_digest
+    assert a.out_digest != b.out_digest
+
+
+def test_sim_crash_recovery_is_green(tmp_path):
+    sched = FaultSchedule(
+        seed=5, num_events=40,
+        events=[{"kind": "crash", "group": 1, "at": 20}])
+    res = run_sim(sched, str(tmp_path))
+    assert res.ok, res.verdicts
+    assert res.counters["crashes"] == 1
+
+
+def test_sim_reshard_mid_run_is_green(tmp_path):
+    sched = FaultSchedule(
+        seed=13, num_events=40,
+        events=[{"kind": "reshard", "at": 22, "to": 3}])
+    res = run_sim(sched, str(tmp_path))
+    assert res.ok, res.verdicts
+    assert res.counters["resharded"] == 1
+    # post-reshard topology really served: three final-gen groups
+    assert len(res.verdicts["conservation"]["pending_reserve"]) == 3
+
+
+def test_sim_grammar_faults_fire_and_stay_green(tmp_path):
+    sched = FaultSchedule(
+        seed=17, num_events=40,
+        clauses=["net.partition:n=1:at=7:ms=50",
+                 "net.reorder:n=1:at=30:ms=20",
+                 "broker.produce:n=1:after=25"])
+    res = run_sim(sched, str(tmp_path))
+    assert res.ok, res.verdicts
+    assert res.counters["faults_fired"] >= 2
+
+
+def test_sim_faults_never_leak_into_process_plan(tmp_path):
+    from kme_tpu import faults
+
+    run_sim(_calm(3, clauses=["broker.fetch:n=1:after=5"]),
+            str(tmp_path))
+    assert not faults.active()      # run_sim clears on every exit
+
+
+def test_sim_rejects_ungrouped_topology(tmp_path):
+    with pytest.raises(ValueError):
+        run_sim(_calm(3, ngroups=1), str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# the planted-bug drill: find -> shrink -> offline red replay
+
+
+def test_planted_bug_is_red_only_when_armed(tmp_path):
+    sched = FaultSchedule(
+        seed=5, num_events=40,
+        events=[{"kind": "crash", "group": 0, "at": 20}])
+    clean = run_sim(sched, str(tmp_path / "clean"))
+    assert clean.ok
+    assert "stamp-reset" in PLANTED_BUGS
+    bugged = run_sim(sched, str(tmp_path / "bug"),
+                     planted_bug="stamp-reset")
+    assert not bugged.ok
+    assert "stamps" in bugged.red_verdicts()
+
+
+def test_unknown_planted_bug_is_an_error(tmp_path):
+    with pytest.raises(ValueError):
+        run_sim(_calm(3), str(tmp_path), planted_bug="nope")
+
+
+def test_shrinker_reduces_to_minimal_crash_and_repro_replays_red(
+        tmp_path):
+    from kme_tpu.sim.shrink import shrink_schedule
+
+    # a noisy schedule: the bug needs only the crash; everything else
+    # is shrinkable adversity
+    sched = FaultSchedule(
+        seed=6, num_events=40,
+        clauses=["net.delay:n=1:at=9:ms=20",
+                 "broker.fetch:n=1:after=30"],
+        events=[{"kind": "crash", "group": 0, "at": 20},
+                {"kind": "storm", "profile": "cancel-storm",
+                 "at": 28, "n": 30}])
+    sr = shrink_schedule(sched, str(tmp_path), max_runs=32,
+                         planted_bug="stamp-reset")
+    assert sr is not None and sr.removed >= 2
+    assert sr.schedule.size() <= 3
+    assert any(ev["kind"] == "crash" for ev in sr.schedule.events)
+    assert not sr.result.ok
+
+    # the written repro is self-contained and replays red offline
+    with open(sr.repro_path) as f:
+        rt = FaultSchedule.from_json(f.read())
+    replay = run_sim(rt, str(tmp_path / "replay"),
+                     planted_bug="stamp-reset")
+    assert not replay.ok
+    # and the same schedule without the bug is green (the shrink kept
+    # a real repro, not a broken harness state)
+    assert run_sim(rt, str(tmp_path / "replay-clean")).ok
+
+    # audit.py-format dump with a ready-to-run xray bisect line
+    with open(sr.dump_path) as f:
+        doc = json.load(f)
+    assert doc["violations"] and doc["inputs"]
+    assert doc["xray"] and doc["xray"].startswith("kme-xray --bisect")
+    assert os.path.exists(doc["checkpoint_ref"])
+
+
+def test_shrink_returns_none_for_green_schedule(tmp_path):
+    from kme_tpu.sim.shrink import shrink_schedule
+
+    assert shrink_schedule(_calm(3), str(tmp_path), max_runs=4) is None
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_cli_dump_schedule_roundtrip(capsys):
+    from kme_tpu.sim.cli import sim_main
+
+    assert sim_main(["--seed", "4", "--dump-schedule"]) == 0
+    dumped = capsys.readouterr().out.strip()
+    assert FaultSchedule.from_json(dumped).seed == 4
+
+
+def test_cli_single_seed_green(tmp_path, capsys):
+    from kme_tpu.sim.cli import sim_main
+
+    rc = sim_main(["--seed", "3", "--events", "40",
+                   "--out", str(tmp_path), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"] is True
+    assert out["trace_digest"] and out["out_digest"]
+
+
+def test_cli_repro_red_exit_code(tmp_path, capsys):
+    from kme_tpu.sim.cli import sim_main
+
+    sched = FaultSchedule(
+        seed=5, num_events=40,
+        events=[{"kind": "crash", "group": 0, "at": 20}])
+    path = tmp_path / "r.json"
+    path.write_text(sched.to_json())
+    assert sim_main(["--repro", str(path), "--out",
+                     str(tmp_path / "g")]) == 0
+    capsys.readouterr()
+    assert sim_main(["--repro", str(path), "--planted-bug",
+                     "stamp-reset", "--out",
+                     str(tmp_path / "r")]) == 1
+
+
+def test_cli_requires_exactly_one_mode():
+    from kme_tpu.sim.cli import sim_main
+
+    with pytest.raises(SystemExit):
+        sim_main([])
+    with pytest.raises(SystemExit):
+        sim_main(["--seed", "1", "--seeds", "0..2"])
+
+
+@pytest.mark.slow
+def test_cli_sweep_finds_and_shrinks_planted_bug(tmp_path, capsys):
+    """The CI drill at test scale: a short sweep with the bug armed
+    must go red on a crash-bearing seed and print a one-line repro."""
+    from kme_tpu.sim.cli import sim_main
+
+    rc = sim_main(["--seeds", "5..9", "--events", "60",
+                   "--planted-bug", "stamp-reset",
+                   "--out", str(tmp_path), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["red"]
+    shrunk = [s for s in out["shrunk"] if s.get("reproduced")]
+    assert shrunk and all(s["size"] <= 3 for s in shrunk)
+    assert all(s["repro"].startswith("kme-sim --repro") for s in shrunk)
