@@ -41,6 +41,11 @@ RULES: Dict[str, str] = {
                 "sleep/time_ns) in a clock-seamed sim-reachable "
                 "function — go through the injected bridge/clock.py "
                 "seam",
+    "KME-E001": "wall clock / RNG in an event-identity path "
+                "(telemetry/events.py) — event KEYS (src, seq, kind, "
+                "detail) must be replay-deterministic bytes; only the "
+                "advisory ts stamp may ride a clock, and only through "
+                "the injected seam",
 }
 
 # -- scope tables -----------------------------------------------------------
@@ -218,6 +223,27 @@ CLOCK_SCOPES: Dict[str, Set[str]] = {
     "kme_tpu/bridge/tcp.py": {"_ats_for"},
 }
 
+# Event-identity scopes (KME-E001, ISSUE 20): the control-plane
+# flight recorder's replay-determinism surface. A merged timeline is
+# digested byte-for-byte (the sim's seventh verdict) and deduped on
+# (src, seq) — so everything that BUILDS event identity (make/encode/
+# order/dedup/digest) and everything that assigns the durable seq
+# cursor (emit + the open/rescan paths) must be clock- and RNG-free.
+# The one sanctioned clock touch is the ADVISORY ts stamp, and it must
+# flow through the injected ``clock`` seam; the default-clock fallback
+# in ``EventLog.__init__`` is the single grandfathered finding (the
+# seam has to bottom out somewhere), held in LINT_BASELINE.json so any
+# NEW wall read or RNG in these functions still gates. Unlike the
+# D-family this rule also flags bare REFERENCES (``x = time.time``):
+# smuggling the function object past the seam is the failure mode the
+# injectable-clock design invites.
+EVENTS_SCOPES: Dict[str, Set[str]] = {
+    "kme_tpu/telemetry/events.py": {
+        "make_event", "event_line", "order_key", "sort_events",
+        "dedup_events", "merge_events", "timeline_digest",
+        "emit", "__init__", "_open_live", "_seed_seq_from_rotated"},
+}
+
 # Profiler scopes (ISSUE 16): the continuous-profiling plane is
 # DELIBERATELY outside every table above, and this entry documents the
 # boundary so the exemption is a reviewed decision rather than an
@@ -298,6 +324,7 @@ class _RuleVisitor(ast.NodeVisitor):
                            | FEED_SCOPES.get(relpath, set())
                            | XRAY_SCOPES.get(relpath, set()))
         self.clock_fns = CLOCK_SCOPES.get(relpath, set())
+        self.events_fns = EVENTS_SCOPES.get(relpath, set())
         self.traced = relpath.startswith(TRACED_DIRS)
 
     # -- bookkeeping ----------------------------------------------------
@@ -342,6 +369,8 @@ class _RuleVisitor(ast.NodeVisitor):
             self._check_replay_call(node, dotted, head, tail)
         if self._in(self.clock_fns):
             self._check_clock_call(node, dotted, head, tail)
+        if self._in(self.events_fns):
+            self._check_events_call(node, dotted, head, tail)
         if self.traced:
             self._visit_traced_call(node)
         self.generic_visit(node)
@@ -391,6 +420,29 @@ class _RuleVisitor(ast.NodeVisitor):
             self._emit("KME-D002", node,
                        f"nondeterminism source '{dotted}()' in a "
                        f"replay-affecting path")
+
+    def _events_offender(self, dotted: str) -> Optional[str]:
+        """The KME-E001 predicate, shared by the call and the bare-
+        reference checks: a wall-clock or RNG dotted name."""
+        head, _, tail = dotted.partition(".")
+        if head in _CLOCK_HEADS and tail in _CLOCK_TAILS:
+            return "wall clock"
+        if dotted in ("datetime.datetime.now", "datetime.now",
+                      "datetime.datetime.utcnow", "datetime.utcnow"):
+            return "wall clock"
+        if head in _RANDOM_MODULES or dotted.startswith(
+                ("np.random", "numpy.random")) or dotted == "os.urandom":
+            return "nondeterminism source"
+        return None
+
+    def _check_events_call(self, node, dotted, head, tail) -> None:
+        kind = self._events_offender(dotted)
+        if kind:
+            self._emit("KME-E001", node,
+                       f"{kind} '{dotted}()' in an event-identity "
+                       f"path — event keys must replay "
+                       f"byte-identically; stamp advisory ts through "
+                       f"the injected clock seam")
 
     def _check_clock_call(self, node, dotted, head, tail) -> None:
         if head in _CLOCK_HEADS and tail in _CLOCK_TAILS:
@@ -531,6 +583,17 @@ class _RuleVisitor(ast.NodeVisitor):
                 self._emit("KME-T003", node,
                            f"'{dotted}' reference in device code "
                            f"(implicit float64 surface)")
+        if self._in(self.events_fns):
+            # KME-E001 flags bare references too: `clock or time.time`
+            # hands the wall clock past the injected seam without a
+            # single call-shaped node
+            dotted = _dotted(node) or ""
+            kind = self._events_offender(dotted)
+            if kind:
+                self._emit("KME-E001", node,
+                           f"{kind} '{dotted}' referenced in an "
+                           f"event-identity path — inject it through "
+                           f"the clock seam instead")
         self.generic_visit(node)
 
 

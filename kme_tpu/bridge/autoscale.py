@@ -166,3 +166,37 @@ def load_trace(path: str) -> List[dict]:
             if ln:
                 out.append(json.loads(ln))
     return out
+
+
+def tick_event(ctl: AutoscaleController, groups: int,
+               lags: Sequence[float],
+               overload_states: Sequence[int],
+               decision: Optional[dict]) -> dict:
+    """The flight-recorder payload for one policy tick, read AFTER
+    ``observe``: which hysteresis phase the controller is in
+    (hot/cold dwell, post-proposal cooldown, steady), the raw inputs
+    it saw, and — when this tick crossed the dwell threshold — the
+    proposal itself. Pure function of controller state: the emitting
+    monitor does the I/O, the policy stays byte-replayable."""
+    if decision is not None:
+        phase = "propose"
+    elif ctl.cooldown_left > 0:
+        phase = "cooldown"
+    elif ctl.hot_streak > 0:
+        phase = "hot-dwell"
+    elif ctl.cold_streak > 0:
+        phase = "cold-dwell"
+    else:
+        phase = "steady"
+    detail = {"phase": phase, "groups": int(groups),
+              "tick": ctl.ticks,
+              "max_lag": round(max(lags), 3) if lags else 0.0,
+              "overloaded": int(sum(1 for s in overload_states if s)),
+              "hot_streak": ctl.hot_streak,
+              "cold_streak": ctl.cold_streak,
+              "cooldown_left": ctl.cooldown_left}
+    if decision is not None:
+        detail.update(action=decision["action"],
+                      to=int(decision["to"]),
+                      imbalance=decision.get("imbalance"))
+    return detail

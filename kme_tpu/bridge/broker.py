@@ -228,6 +228,11 @@ class OverloadController:
         self._fair_ring: List[int] = []
         self._fair_pos = 0
         self._fair_counts: Dict[int, int] = {}
+        # flight-recorder seam: called as cb(prev_code, new_code) on
+        # every state transition. The controller stays a pure state
+        # machine — the callback observes decisions, never makes them,
+        # and a raising callback cannot wedge admission
+        self.on_transition = None
 
     # -- feeds ---------------------------------------------------------
 
@@ -244,8 +249,14 @@ class OverloadController:
 
     def _to(self, state: int) -> None:
         if state != self.state:
-            self.state = state
+            prev, self.state = self.state, state
             self.transitions += 1
+            cb = self.on_transition
+            if cb is not None:
+                try:
+                    cb(prev, state)
+                except Exception:
+                    pass
 
     def _update_state(self, backlog: int) -> None:
         if self.state == self.NORMAL:

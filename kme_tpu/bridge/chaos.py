@@ -352,6 +352,31 @@ def _check_failover(ckpt_dir: str, log_dir: str, recoveries: list,
     return out
 
 
+def _timeline_section(run_dir: str, tail: int = 12) -> dict:
+    """Merge the run's control-plane event logs into the report: the
+    causally ordered timeline of what the cluster DECIDED (spawns,
+    crash fingerprints, promotions, lease grants, overload
+    transitions) during the drill. Also writes the merged
+    ``events.jsonl`` artifact next to the per-process logs so
+    ``kme-events <run_dir>`` and CI artifact uploads find one file."""
+    from kme_tpu.telemetry import events as cpevents
+
+    try:
+        timeline = cpevents.merge_logs([run_dir])
+    except OSError:
+        return {"count": 0, "digest": None, "tail": []}
+    merged_path = os.path.join(run_dir, "events.jsonl")
+    try:
+        cpevents.write_merged(timeline, merged_path)
+    except OSError:
+        merged_path = None
+    return {"count": len(timeline),
+            "digest": cpevents.timeline_digest(timeline),
+            "merged_path": merged_path,
+            "tail": [cpevents.format_event(ev)
+                     for ev in timeline[-tail:]]}
+
+
 def _busy_rate(samples: List[Tuple[float, int]],
                t_lo: float, t_hi: float) -> Optional[float]:
     """Offset-advance rate (msgs/s) of a heartbeat sample series inside
@@ -714,6 +739,7 @@ def run_shard_failover(args, run_dir: str, report_path: str) -> int:
         "verify": dict(verify,
                        mismatches=verify.get("mismatches", [])[:3]),
         "supervisors": sup_states,
+        "timeline": _timeline_section(run_dir),
         "run_dir": run_dir,
     }
     with open(report_path, "w") as f:
@@ -973,6 +999,7 @@ def run_feed_failover(args, run_dir: str, report_path: str) -> int:
         "subscribers": sub_reports,
         "supervisor": sup_state,
         "fault_fires": _fault_fires(state_dir),
+        "timeline": _timeline_section(run_dir),
         "run_dir": run_dir,
     }
     with open(report_path, "w") as f:
@@ -1175,6 +1202,7 @@ def run_reshard_storm(args, run_dir: str, report_path: str) -> int:
                  "--old-groups", str(n), "--new-groups", str(m)]
     kenv = dict(env)
     kenv["KME_TEST_HOOKS"] = "1"
+    t_coord0 = time.time()
     crash = subprocess.run(
         coord_cmd + ["--test-kill-after-legs",
                      str(args.reshard_kill_legs)],
@@ -1185,6 +1213,7 @@ def run_reshard_storm(args, run_dir: str, report_path: str) -> int:
                         f"leg proved nothing")
     rerun = subprocess.run(coord_cmd, env=env, capture_output=True,
                            text=True)
+    t_coord1 = time.time()
     if rerun.returncode != 0:
         failures.append(f"coordinator re-run after the crash exited "
                         f"rc={rerun.returncode}: "
@@ -1397,6 +1426,87 @@ def run_reshard_storm(args, run_dir: str, report_path: str) -> int:
             failures.append(f"SLO: new group {k} p99 {p99:.1f}ms over "
                             f"the {args.reshard_p99_ms}ms bound")
 
+    # -- control-plane timeline: exactly-once phases + wall decompo- --
+    # merge every event log the run left behind (old-generation
+    # supervisors/serves under r0, coordinator + new generation under
+    # r1) into one causally ordered timeline. The coordinator ran
+    # TWICE (SIGKILLed mid-settle, then to done) against the same
+    # phase-ordinal seqs — the merged timeline must hold each phase
+    # EXACTLY once, or the replay-dedup discipline is broken.
+    from kme_tpu.telemetry import events as cpevents
+
+    timeline = cpevents.merge_logs([run_dir])
+    merged_path = os.path.join(run_dir, "events.jsonl")
+    try:
+        cpevents.write_merged(timeline, merged_path)
+    except OSError:
+        merged_path = None
+    phase_counts = {p: 0 for p in
+                    reshard_mod.ReshardCoordinator.PHASES}
+    migrate_off = None
+    for ev in timeline:
+        kind = str(ev.get("kind", ""))
+        if ev.get("src") == "reshard" and kind.startswith("reshard."):
+            p = kind.split(".", 1)[1]
+            if p in phase_counts:
+                phase_counts[p] += 1
+            if p == "migrate":
+                migrate_off = ev.get("off")
+    if not timeline:
+        failures.append("the run left no control-plane events — the "
+                        "flight recorder never engaged")
+    for p, c in phase_counts.items():
+        if c != 1:
+            failures.append(
+                f"merged timeline holds {c} reshard.{p} event(s), "
+                f"want exactly 1 — the post-SIGKILL re-run must dedup "
+                f"its resumed phases, not duplicate (or drop) them")
+    if sizes_pre and migrate_off != max(sizes_pre):
+        failures.append(
+            f"reshard.migrate offset anchor {migrate_off} != drained "
+            f"high-water {max(sizes_pre)} — the timeline would merge "
+            f"out of replay order")
+
+    # reshard_pause_ms decomposed by phase: drain->coordinator gap and
+    # post-coordinator relaunch measured by the drill's clock,
+    # fence/migrate/settle by the coordinator's own (journal walls —
+    # each recorded by whichever incarnation ran the phase, so they
+    # survive the SIGKILL). Independent clocks, so the sum reconciles
+    # against the measured pause within a tolerance that absorbs what
+    # no phase owns: two interpreter spawns and the crashed settle
+    # attempt.
+    jwalls = jdoc.get("walls", {})
+    walls_ms = {
+        "drain": round(max(0.0, t_coord0 - t_drain) * 1000.0, 3),
+        "fence": round(float(jwalls.get("fence_s", 0.0)) * 1000.0, 3),
+        "migrate": round(float(jwalls.get("migrate_s", 0.0))
+                         * 1000.0, 3),
+        "settle": round(float(jwalls.get("settle_s", 0.0))
+                        * 1000.0, 3),
+        "relaunch": (round(max(0.0, min(first_new) - t_coord1)
+                           * 1000.0, 3) if first_new else None),
+    }
+    for p in ("fence", "migrate", "settle"):
+        if f"{p}_s" not in jwalls:
+            failures.append(f"reshard journal carries no {p} wall — "
+                            f"the pause cannot be attributed by phase")
+    unattributed_ms = None
+    if pause is not None and walls_ms["relaunch"] is not None:
+        walls_sum = sum(v for v in walls_ms.values() if v is not None)
+        unattributed_ms = round(pause * 1000.0 - walls_sum, 3)
+        tol_ms = args.reshard_walls_tol * 1000.0
+        if unattributed_ms < -500.0:
+            failures.append(
+                f"phase walls sum {walls_sum:.0f}ms EXCEEDS the "
+                f"measured pause {pause * 1000.0:.0f}ms — a wall is "
+                f"double-counted or a clock ran backwards")
+        elif unattributed_ms > tol_ms:
+            failures.append(
+                f"phase walls account for {walls_sum:.0f}ms of the "
+                f"{pause * 1000.0:.0f}ms pause — "
+                f"{unattributed_ms:.0f}ms unattributed exceeds the "
+                f"{tol_ms:.0f}ms tolerance")
+
     report = {
         "ok": not failures,
         "failures": failures,
@@ -1420,6 +1530,24 @@ def run_reshard_storm(args, run_dir: str, report_path: str) -> int:
         "old_fenced": probes,
         "migration_pause_s": (round(pause, 3)
                               if pause is not None else None),
+        # flat perfgate-scrapeable gauges: reshard_pause_ms decomposed
+        # by phase (perfgate.ADVISORY_METRICS — wall clocks, advisory)
+        "reshard_pause_ms": (round(pause * 1000.0, 3)
+                             if pause is not None else None),
+        "reshard_drain_ms": walls_ms["drain"],
+        "reshard_fence_ms": walls_ms["fence"],
+        "reshard_migrate_ms": walls_ms["migrate"],
+        "reshard_settle_ms": walls_ms["settle"],
+        "reshard_relaunch_ms": walls_ms["relaunch"],
+        "reshard_unattributed_ms": unattributed_ms,
+        "timeline": {
+            "count": len(timeline),
+            "digest": cpevents.timeline_digest(timeline),
+            "phase_counts": phase_counts,
+            "merged_path": merged_path,
+            "tail": [cpevents.format_event(ev)
+                     for ev in timeline[-12:]],
+        },
         "p99_ms": p99s,
         "front_links": link_state,
         "verify": dict(verify,
@@ -1434,7 +1562,10 @@ def run_reshard_storm(args, run_dir: str, report_path: str) -> int:
           f"settle_dedup={settle.get('dup_suppressed')} "
           f"crash_rc={crash.returncode} "
           f"dup_stamps={sum(dup_stamps.values())} "
-          f"pause={report['migration_pause_s']}s fenced={probes} "
+          f"pause={report['migration_pause_s']}s "
+          f"timeline={len(timeline)}ev "
+          f"phases={[phase_counts[p] for p in sorted(phase_counts)]} "
+          f"fenced={probes} "
           f"parity={'byte-exact' if verify['ok'] else 'DIVERGED'} "
           f"elapsed={elapsed:.1f}s", file=sys.stderr)
     for fail in failures:
@@ -1760,6 +1891,7 @@ def run_storm(args, run_dir: str, report_path: str) -> int:
                               if k.startswith("overload_")
                               or k.startswith("shed_by_class")
                               or k.startswith("admitted_by_class")},
+        "timeline": _timeline_section(run_dir),
         "run_dir": run_dir,
     }
     with open(report_path, "w") as f:
@@ -1855,6 +1987,14 @@ def main(argv=None) -> int:
                    help="reshard-under-storm scenario: bound on the "
                         "migration pause, old-generation drain -> "
                         "first new-generation progress (seconds)")
+    p.add_argument("--reshard-walls-tol", type=float, default=20.0,
+                   help="reshard-under-storm scenario: tolerance "
+                        "(seconds) for the pause left unattributed "
+                        "after the per-phase walls (drain/fence/"
+                        "migrate/settle/relaunch) are summed — covers "
+                        "the two coordinator interpreter spawns and "
+                        "the crashed settle attempt, which no phase "
+                        "owns")
     p.add_argument("--reshard-p99-ms", type=float, default=10_000.0,
                    help="reshard-under-storm scenario: bound on the "
                         "new generation's final lat_e2e p99. The "
@@ -2107,6 +2247,7 @@ def main(argv=None) -> int:
         "producer": {"sent": producer.sent,
                      "overload_retries": producer.overload_retries,
                      "reconnects": producer.reconnects},
+        "timeline": _timeline_section(run_dir),
         "run_dir": run_dir,
     }
     with open(report_path, "w") as f:

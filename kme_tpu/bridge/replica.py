@@ -333,6 +333,22 @@ class Replica:
         from kme_tpu.bridge.tcp import parse_addr, serve_broker
 
         svc = self.svc
+        # flight recorder: promotion begin/end bracket the whole
+        # takeover (broker reopen, endpoint rebind, epoch fence) so the
+        # merged timeline shows the failover window, not just its end.
+        # The standby's own source name keeps it distinct from the
+        # supervisor's promote decision in the merged view.
+        from kme_tpu.telemetry import events as cpevents
+
+        evlog = cpevents.open_log(self.checkpoint_dir, "standby",
+                                  clock=self.clock.time)
+        try:
+            evlog.emit("replica.promote.begin",
+                       group=(self.group[0] if self.group else None),
+                       offset=svc.offset,
+                       failed_at=promote.get("failed_at"))
+        except Exception:
+            pass
         if self.tsdb is not None:
             # hand history over to the serve path: the promoted leader
             # continues the LEADER's source series (adopting its
@@ -387,6 +403,15 @@ class Replica:
               f"offset {svc.offset} (out_seq {svc.out_seq}, "
               f"failover {failover if failover is not None else '?'}s)",
               file=sys.stderr)
+        try:
+            evlog.emit("replica.promote.end",
+                       group=(self.group[0] if self.group else None),
+                       epoch=svc.epoch, offset=svc.offset,
+                       out_seq=svc.out_seq,
+                       failover_seconds=failover)
+            evlog.close()
+        except Exception:
+            pass
         try:
             seen = svc.run(max_messages=self.max_messages,
                            idle_exit=self.idle_exit,

@@ -193,12 +193,20 @@ class ReshardCoordinator:
     """
 
     def __init__(self, old_root: str, new_root: str, old_groups: int,
-                 new_groups: int) -> None:
+                 new_groups: int, clock=None) -> None:
         self.old_root, self.new_root = old_root, new_root
         self.n, self.m = int(old_groups), int(new_groups)
         if self.n < 1 or self.m < 1:
             raise ValueError("group counts must be >= 1")
         self.journal_path = os.path.join(new_root, JOURNAL)
+        # injected clock (zero-arg seconds float) stamps the phase
+        # events and measures the phase walls; the sim passes its
+        # virtual clock so the timeline digest stays seed-stable
+        if clock is None:
+            import time as _time
+
+            clock = _time.time
+        self._clock = clock
 
     def _old_dir(self, k: int) -> str:
         return os.path.join(self.old_root, f"group{k}")
@@ -343,25 +351,85 @@ class ReshardCoordinator:
                     sum(1 for leg in legs if leg[0] == k)
                     for k in range(self.m)]}
 
+    # one canonical ordinal per coordinator phase: the flight-recorder
+    # event seq IS the ordinal (durable identity, never a counter), so
+    # a SIGKILL'd coordinator's re-run re-emits every completed phase
+    # and the log's replay dedup keeps the first copy — the merged
+    # timeline shows each phase exactly once however many times the
+    # coordinator died (the reshard-under-storm drill asserts this)
+    PHASES = ("fence", "migrate", "settle", "done")
+
+    def _phase_event(self, evlog, phase: str, j: dict) -> None:
+        info = j.get(phase) or {}
+        offsets = (j.get("migrate") or {}).get("old_offsets") or []
+        detail = {"old_groups": self.n, "new_groups": self.m}
+        wall = (j.get("walls") or {}).get(f"{phase}_s")
+        if wall is not None:
+            detail["wall_s"] = wall
+        epoch = None
+        if phase == "fence":
+            detail["stolen"] = [e["epoch"] for e in
+                                info.get("stolen_epochs", [])]
+            epoch = max(detail["stolen"], default=None)
+        elif phase == "migrate":
+            detail["accounts"] = info.get("accounts")
+            detail["moved_key_frac"] = (info.get("plan") or {}).get(
+                "moved_key_frac")
+        elif phase == "settle":
+            detail["legs"] = info.get("legs")
+            detail["dup_suppressed"] = info.get("dup_suppressed")
+            epoch = max(info.get("epochs", []), default=None)
+        try:
+            evlog.emit(f"reshard.{phase}",
+                       seq=self.PHASES.index(phase), epoch=epoch,
+                       offset=(max(offsets) if offsets
+                               and phase != "fence" else None),
+                       **{k: v for k, v in detail.items()
+                          if v is not None})
+        except Exception:
+            pass    # the recorder never blocks a reshard
+
     def run(self, kill_after_legs: Optional[int] = None) -> dict:
+        from kme_tpu.telemetry import events as cpevents
+
+        os.makedirs(self.new_root, exist_ok=True)
+        evlog = cpevents.open_log(self.new_root, "reshard",
+                                  clock=self._clock)
         j = self._load_journal()
         j.update({"old_root": self.old_root, "new_root": self.new_root,
                   "old_groups": self.n, "new_groups": self.m})
+        # per-phase walls (reshard_pause_ms decomposed): each phase
+        # that RUNS in this incarnation records its wall into the
+        # journal; a completed phase's wall survives a coordinator
+        # SIGKILL via the journal, so the final document always carries
+        # the wall of the run that actually did the work
+        walls = j.setdefault("walls", {})
         if not j.get("fence", {}).get("done"):
+            t0 = self._clock()
             j["fence"] = self._fence_old()
+            walls["fence_s"] = round(self._clock() - t0, 6)
             self._save_journal(j)
+        self._phase_event(evlog, "fence", j)
         if not j.get("migrate", {}).get("done"):
+            t0 = self._clock()
             info, legs = self._migrate()
             j["migrate"] = info
+            walls["migrate_s"] = round(self._clock() - t0, 6)
             self._save_journal(j)
         else:
             legs = j["migrate"]["legs"]
+        self._phase_event(evlog, "migrate", j)
         if not j.get("settle", {}).get("done"):
+            t0 = self._clock()
             j["settle"] = self._settle(legs,
                                        kill_after_legs=kill_after_legs)
+            walls["settle_s"] = round(self._clock() - t0, 6)
             self._save_journal(j)
+        self._phase_event(evlog, "settle", j)
         j["done"] = True
         self._save_journal(j)
+        self._phase_event(evlog, "done", j)
+        evlog.close()
         return j
 
 

@@ -235,6 +235,39 @@ class MatchService:
             q = collections.deque(maxlen=65536)
             self._shed_pending = q
             broker.shed_observer = lambda _topic, d: q.append(d)
+        # control-plane flight recorder (telemetry/events.py): the serve
+        # process's own durable event stream — lease grants, overload
+        # state transitions — living next to the checkpoints so
+        # kme-events merges it with the supervisor/standby logs. The
+        # heartbeat exports its committed-bytes cursor
+        # (events_last_offset/events_lag_bytes) so kme-agg can flag a
+        # frozen recorder under an otherwise-live process
+        self.events = None
+        if checkpoint_dir is not None:
+            from kme_tpu.telemetry import events as cpevents
+
+            src = "follower" if self.follower else "serve"
+            if self.group_count > 1:
+                src = f"{src}.g{self.group_id}"
+            try:
+                self.events = cpevents.open_log(
+                    checkpoint_dir, src, clock=self.clock.time)
+            except OSError:
+                self.events = None
+            ctl = getattr(broker, "overload", None)
+            if self.events is not None and ctl is not None:
+                ev = self.events
+                gid = self.group_id if self.group_count > 1 else None
+                names = type(ctl).STATE_NAMES
+
+                def _overload_event(prev, new):
+                    ev.emit("overload.transition",
+                            severity="warn" if new else "info",
+                            group=gid, from_state=names[prev],
+                            to_state=names[new],
+                            backoff_ms=ctl.backoff_ms)
+
+                ctl.on_transition = _overload_event
         resumed = False
         if checkpoint_dir is not None:
             resumed = self._try_resume(engine, compat, shards, width)
@@ -337,7 +370,8 @@ class MatchService:
                   file=sys.stderr)
             self.exactly_once = False
             return
-        self.epoch = lease.acquire(self.checkpoint_dir)
+        self.epoch = lease.acquire(self.checkpoint_dir,
+                                   events=self.events)
         fence = getattr(self.broker, "fence", None)
         if fence is not None:
             fence(self.epoch)
@@ -586,6 +620,8 @@ class MatchService:
                       file=sys.stderr)
         if getattr(self, "tsdb", None) is not None:
             self.tsdb.close()
+        if getattr(self, "events", None) is not None:
+            self.events.close()
         if getattr(self, "journal", None) is not None:
             self.journal.close()
 
@@ -1810,6 +1846,14 @@ class MatchService:
         if path is None:       # TSDB-only heartbeat (no supervisor)
             self._append_tsdb(snap, seq)
             return
+        # additive events-log keys (COMPAT.md): the committed-bytes
+        # cursor of this process's control-plane event log. kme-agg
+        # reads them to flag a recorder that froze while the heartbeat
+        # itself kept advancing
+        ev = getattr(self, "events", None)
+        evkeys = ({"events_last_offset": ev.last_offset,
+                   "events_lag_bytes": ev.lag_bytes}
+                  if ev is not None else {})
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             # "metrics" is ADDITIVE — the supervisor keys
@@ -1828,6 +1872,7 @@ class MatchService:
                        "epoch": self.epoch,
                        "sample_seq": seq,
                        "every": getattr(self, "_hb_every", 1.0),
+                       **evkeys,
                        "metrics": snap}, f)
         os.replace(tmp, path)
         self._append_tsdb(snap, seq)

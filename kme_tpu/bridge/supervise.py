@@ -150,6 +150,32 @@ class Supervisor:
         self.recoveries: list = []
         self._last_fingerprint: Optional[str] = None
         self._streak = 0
+        # control-plane flight recorder (telemetry/events.py): every
+        # supervision decision — spawn, crash fingerprint, backoff,
+        # restart, promotion/adoption — lands in the durable timeline.
+        # The group ordinal is recovered from the "[gK]" tag so merged
+        # timelines anchor per group; ts comes from the injected clock
+        # (fake-clock unit tests get deterministic stamps for free).
+        from kme_tpu.telemetry import events as cpevents
+
+        self._group = -1
+        if tag.startswith("[g") and tag.endswith("]"):
+            with contextlib.suppress(ValueError):
+                self._group = int(tag[2:-1])
+        src = ("supervisor" if self._group < 0
+               else f"supervisor.g{self._group}")
+        self.events = cpevents.open_log(checkpoint_dir, src,
+                                        clock=self._clock)
+
+    def _event(self, kind: str, severity: str = "info",
+               **detail) -> None:
+        """Append one timeline event; the recorder must never be able
+        to kill supervision."""
+        try:
+            self.events.emit(kind, severity=severity,
+                             group=self._group, **detail)
+        except Exception:
+            pass
 
     # -- small injectable-friendly primitives --------------------------
 
@@ -229,6 +255,8 @@ class Supervisor:
             os.unlink(self.standby_hb)
         self._say("starting kme-standby replica")
         self._standby_proc = self._popen(self.standby_cmd, env)
+        self._event("supervisor.standby_spawn",
+                    restarts=self.standby_restarts)
 
     def _standby_ready(self) -> bool:
         """Promotable = the replica process is alive AND has written a
@@ -304,11 +332,17 @@ class Supervisor:
                 child, adopt = adopt, None
                 self._say("failing over to the hot standby "
                           "(promote.json written)")
+                self._event("supervisor.adopt", pid=child.pid,
+                            fingerprint=self._last_fingerprint)
             else:
                 was_promoted = False
                 self._say(f"starting kme-serve (restart "
                           f"{self.budget_used}/{self.max_restarts})")
                 child = self._popen(self.base_cmd, env)
+                self._event(
+                    "supervisor.restart" if self.restarts_total
+                    else "supervisor.spawn",
+                    ordinal=self.restarts_total, pid=child.pid)
             self._ensure_standby(env)
             start = self._clock()
             failed = fingerprint = None
@@ -339,6 +373,7 @@ class Supervisor:
                     rc = child.returncode
                     if rc == 0:
                         self._say("child exited cleanly")
+                        self._event("supervisor.exit", rc=0)
                         self._stop_standby()
                         self._write_state()
                         return 0
@@ -373,6 +408,13 @@ class Supervisor:
                     self._say(f"recovered in {took:.2f}s"
                               + (" (hot failover)" if was_promoted
                                  else ""))
+                    self._event("supervisor.recover",
+                                recovered_in=entry["recovered_in"],
+                                fingerprint=self._last_fingerprint,
+                                promoted=was_promoted,
+                                **({"failover_seconds":
+                                    entry["failover_seconds"]}
+                                   if was_promoted else {}))
                     recovering = None
                     self._write_state()
                 if age > self.stale_after:
@@ -397,12 +439,16 @@ class Supervisor:
                     break
             failed_at = self._clock()
             self._say(f"FAILURE DETECTED: {failed}")
+            self._event("supervisor.crash", severity="error",
+                        fingerprint=fingerprint, reason=failed)
             if child.poll() is None:
                 child.send_signal(signal.SIGKILL)
                 child.wait()
             self._note_failure(fingerprint)
             if self.budget_used > self.max_restarts:
                 self._say("restart budget exhausted")
+                self._event("supervisor.giveup", severity="error",
+                            restarts=self.restarts_total)
                 self._stop_standby()
                 return 1
             if self._standby_ready():
@@ -415,12 +461,19 @@ class Supervisor:
                 adopt, self._standby_proc = self._standby_proc, None
                 self._write_promote(failed_at, adopt.pid)
                 was_promoted = True
+                self._event("supervisor.promote", pid=adopt.pid,
+                            failed_at=failed_at,
+                            fingerprint=self._last_fingerprint)
                 continue    # no backoff: not the same process crashing
             delay = self._backoff()
             if delay > 0:
                 self._say(f"backing off {delay:.2f}s "
                           f"(failure streak {self._streak} "
                           f"x {self._last_fingerprint})")
+                self._event("supervisor.backoff", severity="warn",
+                            seconds=round(delay, 3),
+                            streak=self._streak,
+                            fingerprint=self._last_fingerprint)
                 self._sleep(delay)
 
 
@@ -444,11 +497,13 @@ def _autoscale_monitor(state_root: str, groups: int, stop, cfg,
     executing a proposal is a drain + kme-reshard + restart under the
     new topology — an operator/drill decision, never a background one
     (the running serves' topology is immutable by construction)."""
-    from kme_tpu.bridge.autoscale import AutoscaleController
+    from kme_tpu.bridge.autoscale import AutoscaleController, tick_event
+    from kme_tpu.telemetry import events as cpevents
 
     ctl = AutoscaleController(cfg)
     dec_path = os.path.join(state_root, "autoscale.json")
     trace_path = os.path.join(state_root, "autoscale.trace.jsonl")
+    evlog = cpevents.open_log(state_root, "autoscale")
 
     def write_decisions() -> None:
         tmp = dec_path + ".tmp"
@@ -479,6 +534,13 @@ def _autoscale_monitor(state_root: str, groups: int, stop, cfg,
             trace.write(json.dumps(sample) + "\n")
             trace.flush()
             d = ctl.observe(groups, lags, states)
+            try:
+                evlog.emit("autoscale.propose" if d is not None
+                           else "autoscale.observe",
+                           severity="warn" if d is not None else "info",
+                           **tick_event(ctl, groups, lags, states, d))
+            except Exception:
+                pass    # the recorder never kills the policy loop
             if d is not None:
                 write_decisions()
                 if echo:
@@ -487,6 +549,7 @@ def _autoscale_monitor(state_root: str, groups: int, stop, cfg,
                           f"{d['max_lag']:.0f}, imbalance "
                           f"{d['imbalance']})", file=sys.stderr)
     write_decisions()
+    evlog.close()
 
 
 def supervise_groups(serve_args, state_root: str, groups: int,

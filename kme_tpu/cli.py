@@ -608,6 +608,16 @@ def agg_main(argv=None) -> int:
                 stale[src] = {"age_s": round(age, 3),
                               "intervals": round(age / every, 2),
                               "sample_seq": hb.get("sample_seq")}
+            elif (isinstance(hb.get("events_lag_bytes"), (int, float))
+                    and hb["events_lag_bytes"] > 0):
+                # heartbeat is live but the control-plane event
+                # recorder has unflushed bytes: the process advances
+                # while its timeline froze — a distinct STALE variant
+                # (the inverse of a stalled heartbeat)
+                stale[src] = {
+                    "sample_seq": hb.get("sample_seq"),
+                    "events_frozen": True,
+                    "events_lag_bytes": int(hb["events_lag_bytes"])}
     doc = dtrace.aggregate(snaps, slo_ms=args.slo_ms,
                            slo_target=args.slo_target,
                            stale=stale or None)
@@ -627,6 +637,22 @@ def agg_main(argv=None) -> int:
         doc["history"] = {
             src: _tsdb.window_summary(args.history, source=src)
             for src in hist_sources}
+    recent = []
+    if args.state_root:
+        # recent control-plane events ride the aggregate: the tail of
+        # the merged cluster timeline in the text view, the full merged
+        # timeline (+ its digest) under an "events" key in --json/--out
+        from kme_tpu.telemetry import events as cpevents
+
+        try:
+            recent = cpevents.merge_logs([args.state_root])
+        except OSError:
+            recent = []
+        if recent:
+            doc["events"] = {
+                "count": len(recent),
+                "digest": cpevents.timeline_digest(recent),
+                "timeline": recent}
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=1)
@@ -640,6 +666,13 @@ def agg_main(argv=None) -> int:
             for src in hist_sources:
                 for ln in history_lines(args.history, source=src):
                     print(ln)
+        if recent:
+            from kme_tpu.telemetry import events as cpevents
+
+            print(f"  recent events (last {min(8, len(recent))} of "
+                  f"{len(recent)} — kme-events for the full timeline):")
+            for ev in recent[-8:]:
+                print(f"    {cpevents.format_event(ev)}")
     return 0 if any(s for _n, s in snaps) else 1
 
 
@@ -1229,12 +1262,23 @@ def sim_main(argv=None) -> int:
     return _main(argv)
 
 
+def events_main(argv=None) -> int:
+    """Control-plane flight recorder query tool: merge per-process
+    event logs into one causally-ordered cluster timeline, filter or
+    follow it, explain one event from the TSDB history (--why), or
+    render it as Chrome trace-events."""
+    from kme_tpu.telemetry.events_cli import main as _main
+
+    return _main(argv)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m kme_tpu.cli")
     p.add_argument("command", choices=(
         "loadgen", "oracle", "bench", "serve", "consume", "provision",
         "supervise", "standby", "trace", "chaos", "top", "lint",
-        "front", "agg", "feed", "reshard", "prof", "xray", "sim"))
+        "front", "agg", "feed", "reshard", "prof", "xray", "sim",
+        "events"))
     args, rest = p.parse_known_args(argv)
     try:
         return {
@@ -1247,6 +1291,7 @@ def main(argv=None) -> int:
             "agg": agg_main, "feed": feed_main,
             "reshard": reshard_main, "prof": prof_main,
             "xray": xray_main, "sim": sim_main,
+            "events": events_main,
         }[args.command](rest)
     except BrokenPipeError:
         # downstream closed the pipe (e.g. `| head`) — the Unix-polite

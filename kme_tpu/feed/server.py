@@ -92,7 +92,7 @@ class FeedServer:
                  registry: Optional[Registry] = None,
                  ckpt_dir: Optional[str] = None,
                  snapshot_every: int = 0,
-                 reconnect=None) -> None:
+                 reconnect=None, events=None) -> None:
         self.broker = broker
         self.topic = topic
         self.group = group
@@ -135,6 +135,18 @@ class FeedServer:
         self.address = self._lsock.getsockname()
         self._stop = False
         self._snap_countdown = self.snapshot_every
+        # control-plane flight recorder (telemetry/events.py): each
+        # slow-consumer degradation and its heal is a timeline event
+        self.events = events
+
+    def _event(self, kind: str, severity: str = "info", **kw) -> None:
+        if self.events is None:
+            return
+        try:
+            self.events.emit(kind, severity=severity, group=self.group,
+                             offset=self.offset, **kw)
+        except Exception:
+            pass
 
     # -- subscriber management ------------------------------------------
 
@@ -253,6 +265,9 @@ class FeedServer:
                     sub.qbytes = 0
                     sub.conflating = True
                     self.c_conflations.inc()
+                    self._event("feed.conflate", severity="warn",
+                                peer=f"{sub.addr[0]}:{sub.addr[1]}",
+                                dirty=len(sub.dirty))
                     # keep WRITE interest: the next writable event with
                     # an empty queue IS the drain signal that triggers
                     # the resync
@@ -274,10 +289,14 @@ class FeedServer:
             out += ff.encode_resync(self.group, seq, ep, sq, sid)
             out += ff.encode_depth(self.group, seq, ep, sq, sid,
                                    bids, asks, refresh=True)
+        healed = len(sub.dirty)
         sub.ctob.clear()
         sub.dirty.clear()
         sub.conflating = False
         self.c_resyncs.inc()
+        self._event("feed.resync", epoch=ep,
+                    peer=f"{sub.addr[0]}:{sub.addr[1]}",
+                    symbols=healed, src_seq=sq)
         if out:
             self._enqueue_bytes(sub, out)
 
@@ -489,13 +508,23 @@ def main(argv=None) -> int:
     k, n = int(k), int(n or 1)
     topic = args.topic or (f"{TOPIC_OUT}.g{k}" if n > 1 else TOPIC_OUT)
     registry = Registry()
+    evlog = None
+    if args.state_root:
+        from kme_tpu.telemetry import events as cpevents
+
+        os.makedirs(args.state_root, exist_ok=True)
+        try:
+            evlog = cpevents.open_log(
+                args.state_root, f"feed.g{k}" if n > 1 else "feed")
+        except OSError:
+            evlog = None
     server = FeedServer(
         TcpBroker(bhost, bport), host=lhost, port=lport, group=k,
         topic=topic, depth_every=args.depth_every,
         depth_levels=args.depth_levels, queue_bytes=args.queue_bytes,
         registry=registry, ckpt_dir=args.checkpoint_dir,
         snapshot_every=args.snapshot_every,
-        reconnect=lambda: TcpBroker(bhost, bport))
+        reconnect=lambda: TcpBroker(bhost, bport), events=evlog)
     httpd = None
     if args.metrics_port is not None:
         from kme_tpu.telemetry.httpd import start_metrics_server
@@ -542,6 +571,8 @@ def main(argv=None) -> int:
         pass
     finally:
         server.close()
+        if evlog is not None:
+            evlog.close()
         if tsdb is not None:
             tsdb.close()
         if httpd is not None:

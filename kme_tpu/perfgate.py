@@ -105,7 +105,17 @@ ADVISORY_METRICS = ("pipeline_speedup", "journal_overhead_frac",
                     # clocks/bandwidth probes on shared runners — the
                     # prof suite enforces its own 3% overhead ceiling
                     # in-process instead
-                    "prof_overhead_frac", "transfer_compute_ratio")
+                    "prof_overhead_frac", "transfer_compute_ratio",
+                    # control-plane timeline (ISSUE r20): the reshard
+                    # drill's migration pause decomposed by phase
+                    # (chaos.py reshard-under-storm report) — process
+                    # spawns and drill pacing dominate these walls on
+                    # shared runners, so they trend advisory-down
+                    # rather than gate
+                    "reshard_pause_ms", "reshard_drain_ms",
+                    "reshard_fence_ms", "reshard_migrate_ms",
+                    "reshard_settle_ms", "reshard_relaunch_ms",
+                    "reshard_unattributed_ms")
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
 

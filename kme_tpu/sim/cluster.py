@@ -493,7 +493,10 @@ class _SimCluster:
         old_root = self.gen_root()
         self.generation += 1
         new_root = self.gen_root()
-        coord = ReshardCoordinator(old_root, new_root, n, m)
+        # the coordinator stamps its phase events with the virtual
+        # clock — the timeline verdict needs seed-stable event bytes
+        coord = ReshardCoordinator(old_root, new_root, n, m,
+                                   clock=SimClockView(self.sched).time)
         j = coord.run()
         cursors = j["settle"]["resume_cursors"]
         self.pre_matchout = pre
@@ -694,9 +697,31 @@ def run_sim(schedule: FaultSchedule, root: str,
             h.update(ln.encode("utf-8"))
             h.update(b"\n")
 
+    # seventh verdict: the control-plane timeline. The embedded REAL
+    # components (MatchService lease grants, the reshard coordinator's
+    # phase events) wrote virtual-clock-stamped event logs under the
+    # run root; merge them, verify every segment (digests, seq gaps),
+    # and fold the timeline digest into trace_digest so the seed-sweep
+    # byte-determinism check extends to the control plane for free
+    from kme_tpu.telemetry import events as cpevents
+
+    tl = cpevents.merge_logs([root])
+    tl_digest = cpevents.timeline_digest(tl)
+    bad_logs = []
+    for lp in cpevents.discover_logs(root):
+        rep = cpevents.verify_log(lp)
+        if not rep.get("ok", False) or rep.get("seq_gaps"):
+            bad_logs.append({"path": os.path.relpath(lp, root),
+                             "seq_gaps": rep.get("seq_gaps", 0)})
+    verdicts["timeline"] = {"ok": bool(tl) and not bad_logs,
+                            "events": len(tl), "digest": tl_digest,
+                            "bad_logs": bad_logs}
+    trace_digest = hashlib.sha256(
+        (sched.digest() + tl_digest).encode("ascii")).hexdigest()
+
     ok = all(v.get("ok", False) for v in verdicts.values())
     return SimResult(seed=schedule.seed, ok=ok, verdicts=verdicts,
-                     trace_digest=sched.digest(),
+                     trace_digest=trace_digest,
                      out_digest=h.hexdigest(), schedule=schedule,
                      counters=counters, vtime=round(sched.now, 6),
                      events=list(sched.events))

@@ -28,7 +28,10 @@ The output is a repro kit under ``out_dir``:
   events / inputs / checkpoint_ref / xray) whose ``xray`` field is a
   ready-to-run ``kme-xray --bisect`` line over the red run's journal,
   so the time-travel debugger picks up exactly where the sim verdict
-  left off.
+  left off;
+- ``events.jsonl`` — the red run's merged control-plane timeline
+  (telemetry/events.py): every lease grant and reshard phase the
+  cluster decided on the way to the red verdict.
 """
 
 from __future__ import annotations
@@ -237,6 +240,19 @@ def _write_repro_kit(out: ShrinkResult, workdir: str, run_root: str,
     out.dump_path = os.path.join(workdir, "sim_repro.json")
     with open(out.dump_path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
+
+    # the red run's merged control-plane timeline rides along: what
+    # the cluster DECIDED (lease grants, reshard phases) on the way to
+    # the red verdict, in one causally-ordered events.jsonl artifact
+    from kme_tpu.telemetry import events as cpevents
+
+    try:
+        tl = cpevents.merge_logs([run_root])
+        if tl:
+            cpevents.write_merged(
+                tl, os.path.join(workdir, "events.jsonl"))
+    except OSError:
+        pass
 
 
 def _xray_ref(run_root: str, res: Optional[SimResult],

@@ -12,6 +12,8 @@ order-lifecycle flight recorder, and continuous invariant auditing.
 - top: the kme-top live operations dashboard
 - tsdb: on-disk metrics history (fixed-width binary segments)
 - profiler: continuous host/device profiling + trigger captures
+- events: control-plane flight recorder (durable cluster event
+  timeline) + the kme-events merge/query pipeline
 """
 
 from kme_tpu.telemetry.registry import (  # noqa: F401
@@ -54,6 +56,14 @@ from kme_tpu.telemetry.tsdb import (  # noqa: F401
     flatten_snapshot,
     read_samples,
     window_summary,
+)
+from kme_tpu.telemetry.events import (  # noqa: F401
+    EventLog,
+    merge_events,
+    merge_logs,
+    open_log,
+    read_log,
+    timeline_digest,
 )
 from kme_tpu.telemetry.profiler import (  # noqa: F401
     StageProfiler,

@@ -604,6 +604,13 @@ def aggregate(snaps: Sequence[Tuple[str, dict]],
             row["hb_age_s"] = stale[name].get("age_s")
             row["hb_intervals"] = stale[name].get("intervals")
             row["hb_sample_seq"] = stale[name].get("sample_seq")
+            if stale[name].get("events_frozen"):
+                # the control-plane event recorder wedged while the
+                # heartbeat kept advancing (events_lag_bytes > 0):
+                # the timeline describes the past, flag it loudly
+                row["events_frozen"] = True
+                row["events_lag_bytes"] = stale[name].get(
+                    "events_lag_bytes")
         for k, v in g.items():
             if k.startswith("group") and (k.endswith("_lag")
                                           or k.endswith("_imbalance")):
@@ -676,10 +683,15 @@ def render_agg(doc: dict) -> str:
             f"{k}={row[k]}" for k in sorted(row)
             if k not in ("source", "up", "e2e_p99_ms", "orders",
                          "stale", "hb_age_s", "hb_intervals",
-                         "hb_sample_seq"))
+                         "hb_sample_seq", "events_frozen",
+                         "events_lag_bytes"))
         mark = ""
         if row.get("stale"):
             bits = []
+            if row.get("events_frozen"):
+                bits.append(f"event log frozen "
+                            f"({row.get('events_lag_bytes', 0)}B "
+                            f"unflushed)")
             if row.get("hb_age_s") is not None:
                 bits.append(f"heartbeat {row['hb_age_s']:.1f}s old "
                             f"({row.get('hb_intervals', 0):.1f} "

@@ -273,6 +273,27 @@ def feed_lines(node: dict, indent: str = "") -> list:
     return lines
 
 
+def event_lines(state_root: str, limit: int = 6,
+                indent: str = "") -> list:
+    """Recent-events pane: the tail of the merged control-plane
+    timeline (telemetry/events.py) under a state root — restarts,
+    promotions, fences, autoscale proposals — one line each. Empty
+    when no writer has an event log yet."""
+    try:
+        from kme_tpu.telemetry import events as cpevents
+
+        merged = cpevents.merge_logs([state_root])
+    except Exception:
+        return []
+    if not merged:
+        return []
+    lines = [f"{indent}events   (last {min(limit, len(merged))} of "
+             f"{len(merged)} — kme-events for the full timeline):"]
+    for ev in merged[-limit:]:
+        lines.append(f"{indent}  {cpevents.format_event(ev)}")
+    return lines
+
+
 def render(view: dict, width: int = 78) -> list:
     """The dashboard frame as plain lines (shared by the curses loop
     and --once; pure so the smoke test can assert on it)."""
@@ -458,6 +479,10 @@ def render(view: dict, width: int = 78) -> list:
             if isinstance(rec, dict):
                 lines.append("  recovery: " + " ".join(
                     f"{k}={rec[k]}" for k in sorted(rec)))
+    evs = view.get("events")
+    if evs:
+        lines.append("")
+        lines.extend(evs)
     lines.append(bar)
     return lines
 
@@ -534,6 +559,8 @@ def _curses_loop(args) -> int:
             view = build_view(cur, prev)
             if args.tsdb:
                 view["history"] = history_lines(args.tsdb)
+            if args.state_root:
+                view["events"] = event_lines(args.state_root)
             prev = cur
             scr.erase()
             maxy, maxx = scr.getmaxyx()
@@ -609,11 +636,15 @@ def main(argv=None) -> int:
             for ln in render_cluster(collect_cluster(eps["groups"]),
                                      prev):
                 print(ln)
+            for ln in event_lines(args.state_root):
+                print(ln)
             return 0
         try:
             while True:
                 cur = collect_cluster(eps["groups"])
                 for ln in render_cluster(cur, prev):
+                    print(ln)
+                for ln in event_lines(args.state_root):
                     print(ln)
                 prev = cur
                 time.sleep(args.interval)
@@ -633,6 +664,8 @@ def main(argv=None) -> int:
         view = build_view(cur, prev)
         if args.tsdb:
             view["history"] = history_lines(args.tsdb)
+        if args.state_root:
+            view["events"] = event_lines(args.state_root)
         for ln in render(view):
             print(ln)
         return 0
@@ -650,6 +683,8 @@ def main(argv=None) -> int:
                 view = build_view(cur, prev)
                 if args.tsdb:
                     view["history"] = history_lines(args.tsdb)
+                if args.state_root:
+                    view["events"] = event_lines(args.state_root)
                 for ln in render(view):
                     print(ln)
                 prev = cur

@@ -83,6 +83,27 @@ import random
 def write_events(path):
     jitter = random.random()
 """),
+    ("KME-E001", "kme_tpu/telemetry/events.py", """
+import uuid
+def make_event(source, seq, kind, ts_us):
+    return {"src": source, "seq": seq, "kind": kind,
+            "id": uuid.uuid4().hex}
+""", """
+import uuid
+def write_merged(events, path):
+    tmp = path + uuid.uuid4().hex
+"""),
+    ("KME-E001", "kme_tpu/telemetry/events.py", """
+import time
+class EventLog:
+    def emit(self, kind):
+        fallback = time.time
+""", """
+import time
+class EventLog:
+    def flush(self):
+        self._last_flush = time.time()
+"""),
     ("KME-T001", "kme_tpu/engine/newkernel.py", """
 import jax.numpy as jnp
 def step(state, price):

@@ -1,0 +1,328 @@
+"""Control-plane flight recorder (telemetry/events.py) contracts:
+writer durability round-trips (rotation + sha256 sidecars, torn-tail
+recovery, crash-replay dedup on explicit seqs), the merge laws
+(offset-anchored causal order, first-wins dedup that keeps colliding
+DISTINCT writers, byte-deterministic digests), the supervisor's
+decision sequence under a fake clock, and the kme-events CLI —
+filters, artifacts, and --why attribution against a planted TSDB
+regression."""
+
+import json
+import os
+
+from kme_tpu.telemetry import events as ev
+from kme_tpu.telemetry import events_cli
+
+
+def _kinds(events):
+    return [e["kind"] for e in events]
+
+
+# -- writer round-trips -----------------------------------------------------
+
+
+def test_emit_persist_roundtrip(tmp_path):
+    log = ev.open_log(str(tmp_path), "serve", clock=lambda: 12.5)
+    assert log.emit("lease.grant", epoch=3, group=1, offset=40,
+                    role="leader")
+    assert log.emit("overload.transition", severity="warn",
+                    from_state="admit", to_state="shed")
+    log.close()
+    got = ev.read_log(ev.log_path(str(tmp_path), "serve"))
+    assert _kinds(got) == ["lease.grant", "overload.transition"]
+    assert [e["seq"] for e in got] == [0, 1]
+    first = got[0]
+    assert first["src"] == "serve"
+    assert first["ts"] == int(12.5e6)
+    assert first["sev"] == "info"
+    assert first["g"] == 1 and first["epoch"] == 3 and first["off"] == 40
+    assert first["detail"] == {"role": "leader"}
+    assert got[1]["sev"] == "warn"
+
+
+def test_seq_resumes_across_reopen(tmp_path):
+    log = ev.open_log(str(tmp_path), "s")
+    for _ in range(3):
+        log.emit("a")
+    log.close()
+    log = ev.open_log(str(tmp_path), "s")
+    assert log.last_seq == 2
+    log.emit("b")
+    log.close()
+    got = ev.read_log(ev.log_path(str(tmp_path), "s"))
+    assert [e["seq"] for e in got] == [0, 1, 2, 3]
+    rep = ev.verify_log(ev.log_path(str(tmp_path), "s"))
+    assert rep["ok"] and rep["seq_gaps"] == 0 and rep["events"] == 4
+
+
+def test_torn_tail_recovered_on_reopen(tmp_path):
+    log = ev.open_log(str(tmp_path), "s")
+    for _ in range(3):
+        log.emit("a")
+    log.close()
+    path = ev.log_path(str(tmp_path), "s")
+    with open(path, "ab") as f:
+        f.write(b'{"src": "s", "seq": 3, "kind": "torn-mid-app')
+    # readers skip the torn tail ...
+    assert [e["seq"] for e in ev.iter_log(path)] == [0, 1, 2]
+    # ... and the writer truncates it, then continues the cursor
+    log = ev.open_log(str(tmp_path), "s")
+    assert log.last_seq == 2
+    log.emit("b")
+    log.close()
+    got = ev.read_log(path)
+    assert [e["seq"] for e in got] == [0, 1, 2, 3]
+    assert _kinds(got)[-1] == "b"
+
+
+def test_explicit_seq_crash_replay_dedup(tmp_path):
+    # the reshard-coordinator discipline: seq = durable phase ordinal,
+    # re-emitted wholesale by a post-crash re-run
+    log = ev.open_log(str(tmp_path), "reshard")
+    assert log.emit("reshard.fence", seq=0)
+    assert log.emit("reshard.migrate", seq=1)
+    log.close()
+    rerun = ev.open_log(str(tmp_path), "reshard")
+    assert rerun.emit("reshard.fence", seq=0) is False
+    assert rerun.emit("reshard.migrate", seq=1) is False
+    assert rerun.emit("reshard.settle", seq=2)
+    assert rerun.emit("reshard.done", seq=3)
+    assert rerun.dup_skipped == 2
+    rerun.close()
+    got = ev.read_log(ev.log_path(str(tmp_path), "reshard"))
+    assert _kinds(got) == ["reshard.fence", "reshard.migrate",
+                           "reshard.settle", "reshard.done"]
+
+
+def test_rotation_sidecars_and_cursor_seed(tmp_path):
+    log = ev.open_log(str(tmp_path), "s", rotate_bytes=4096)
+    for _ in range(60):
+        log.emit("tick", pad="x" * 200)
+    log.close()
+    path = ev.log_path(str(tmp_path), "s")
+    assert os.path.exists(f"{path}.1")
+    with open(f"{path}.1.sha256") as f:
+        side = json.load(f)
+    assert side["bytes"] == os.path.getsize(f"{path}.1")
+    got = ev.read_log(path)
+    assert [e["seq"] for e in got] == list(range(60))
+    assert ev.verify_log(path)["ok"]
+    # crash exactly at the rotation boundary: live file empty, cursor
+    # must seed from the newest rotated segment or dedup dies
+    os.truncate(path, 0)
+    log = ev.open_log(str(tmp_path), "s", rotate_bytes=4096)
+    assert log.last_seq == 59
+    log.close()
+
+
+def test_rotated_segment_corruption_detected(tmp_path):
+    log = ev.open_log(str(tmp_path), "s", rotate_bytes=4096)
+    for _ in range(60):
+        log.emit("tick", pad="x" * 200)
+    log.close()
+    path = ev.log_path(str(tmp_path), "s")
+    with open(f"{path}.1", "r+b") as f:
+        f.seek(10)
+        f.write(b"CORRUPT")
+    rep = ev.verify_log(path)
+    assert rep["ok"] is False
+    assert any(s["digest_ok"] is False for s in rep["segments"])
+
+
+def test_prune_beyond_retain(tmp_path):
+    log = ev.open_log(str(tmp_path), "s", rotate_bytes=4096, retain=1)
+    for _ in range(120):
+        log.emit("tick", pad="x" * 200)
+    log.close()
+    path = ev.log_path(str(tmp_path), "s")
+    assert os.path.exists(f"{path}.1")
+    assert not os.path.exists(f"{path}.2")
+    assert ev.verify_log(path)["ok"]
+
+
+def test_disabled_recorder_touches_no_disk(tmp_path, monkeypatch):
+    monkeypatch.setenv("KME_EVENTS", "0")
+    log = ev.open_log(str(tmp_path / "sub"), "s")
+    assert log.emit("a") is False
+    assert not os.path.exists(str(tmp_path / "sub"))
+    log.close()
+    monkeypatch.delenv("KME_EVENTS")
+    off = ev.EventLog(str(tmp_path / "off.jsonl"), "s", enabled=False)
+    assert off.emit("a") is False
+    assert not os.path.exists(str(tmp_path / "off.jsonl"))
+
+
+def test_last_offset_monotonic_and_lag_bytes(tmp_path):
+    log = ev.open_log(str(tmp_path), "s", rotate_bytes=4096,
+                      fsync=False)
+    log.emit("a")
+    assert log.lag_bytes > 0          # written, not yet fsync'd
+    log.flush()
+    assert log.lag_bytes == 0
+    before = log.last_offset
+    for _ in range(60):               # forces at least one rotation
+        log.emit("tick", pad="x" * 200)
+    assert log.last_offset > before + 60 * 200   # never rewound
+    log.close()
+
+
+# -- merge laws -------------------------------------------------------------
+
+
+def test_offset_anchors_beat_skewed_walltime_within_group():
+    # src A's clock runs 1000s ahead; both events carry group-7 offset
+    # anchors, so replay position must win over walltime
+    late_clock = ev.make_event("serve.g7", 0, "late-but-first",
+                               int(2000e6), group=7, offset=10)
+    early_clock = ev.make_event("standby.g7", 0, "early-but-second",
+                                int(1000e6), group=7, offset=20)
+    merged = ev.merge_events([[late_clock], [early_clock]])
+    assert _kinds(merged) == ["late-but-first", "early-but-second"]
+    # unanchored events keep walltime order
+    a = ev.make_event("x", 0, "first", int(1e6))
+    b = ev.make_event("y", 0, "second", int(2e6))
+    assert _kinds(ev.merge_events([[b], [a]])) == ["first", "second"]
+
+
+def test_dedup_drops_replays_keeps_colliding_writers():
+    e1 = ev.make_event("serve.g0", 0, "lease.grant", int(1e6))
+    # same (src, seq) but DIFFERENT bytes: a second writer of the same
+    # source name (e.g. the next reshard generation's serve.g0), not a
+    # replay — both must survive the merge
+    e2 = ev.make_event("serve.g0", 0, "lease.grant", int(9e6))
+    assert len(ev.merge_events([[e1], [e1]])) == 1      # true replay
+    assert len(ev.merge_events([[e1], [e2]])) == 2      # collision
+    assert len(ev.merge_events([[e1, e2], [e2, e1]])) == 2
+
+
+def test_timeline_digest_input_order_independent(tmp_path):
+    evs = [ev.make_event(f"s{i % 3}", i // 3, "k", int((9 - i) * 1e6))
+           for i in range(9)]
+    d1 = ev.timeline_digest(ev.merge_events([evs]))
+    d2 = ev.timeline_digest(ev.merge_events([list(reversed(evs))]))
+    assert d1 == d2
+    # and the merged artifact re-merges to the same digest
+    out = str(tmp_path / "events.jsonl")
+    ev.write_merged(ev.merge_events([evs]), out)
+    assert ev.timeline_digest(ev.merge_logs([str(tmp_path)])) == d1
+
+
+# -- the supervisor's decision sequence under a fake clock ------------------
+
+
+def test_supervisor_crash_restart_sequence_under_fake_clock(tmp_path):
+    from test_supervise_unit import Harness
+
+    h = Harness(tmp_path)
+    h._pending[0].exit_after, h._pending[0].rc = 1.0, 1
+    h._pending[1].exit_after, h._pending[1].rc = 1.0, 0
+    assert h.sup.run() == 0
+    got = ev.read_log(ev.log_path(str(tmp_path), "supervisor"))
+    assert _kinds(got) == [
+        "supervisor.spawn", "supervisor.crash", "supervisor.backoff",
+        "supervisor.restart", "supervisor.recover", "supervisor.exit"]
+    assert [e["seq"] for e in got] == list(range(6))
+    # stamps come from the injected fake clock (seconds from 0), not
+    # the wall — and never run backwards
+    ts = [e["ts"] for e in got]
+    assert ts == sorted(ts) and ts[-1] < int(1e9)
+    crash = got[1]
+    assert crash["sev"] == "error"
+    assert crash["detail"]["fingerprint"] == "exit:1"
+    assert got[2]["detail"]["seconds"] > 0
+
+
+def test_supervisor_promotion_sequence_under_fake_clock(tmp_path):
+    from test_supervise_unit import StandbyHarness
+
+    h = StandbyHarness(tmp_path)
+    h._pending[0].exit_after, h._pending[0].rc = 2.0, 1
+    adoptee = h._standby_pending[0]
+    adoptee.exit_after, adoptee.rc = 8.0, 0
+    assert h.sup.run() == 0
+    got = ev.read_log(ev.log_path(str(tmp_path), "supervisor"))
+    assert _kinds(got) == [
+        "supervisor.spawn", "supervisor.standby_spawn",
+        "supervisor.crash", "supervisor.promote", "supervisor.adopt",
+        "supervisor.standby_spawn", "supervisor.recover",
+        "supervisor.exit"]
+    promote = got[3]
+    assert promote["detail"]["pid"] == adoptee.pid
+    recover = got[6]
+    assert recover["detail"]["promoted"] is True
+    assert recover["detail"]["failover_seconds"] > 0
+    rep = ev.verify_log(ev.log_path(str(tmp_path), "supervisor"))
+    assert rep["ok"] and rep["seq_gaps"] == 0
+
+
+# -- kme-events CLI ---------------------------------------------------------
+
+
+def _write_two_logs(root):
+    a = ev.open_log(str(root), "serve.g0", clock=lambda: 10.0)
+    a.emit("lease.grant", epoch=1, group=0, role="leader")
+    a.emit("overload.transition", severity="warn", group=0,
+           from_state="admit", to_state="shed")
+    a.close()
+    b = ev.open_log(str(root), "supervisor", clock=lambda: 11.0)
+    b.emit("supervisor.spawn", pid=123)
+    b.close()
+
+
+def test_cli_filters_and_artifacts(tmp_path, capsys):
+    _write_two_logs(tmp_path)
+    out_path = str(tmp_path / "merged" / "events.jsonl")
+    os.makedirs(str(tmp_path / "merged"))
+    chrome = str(tmp_path / "trace.json")
+    rc = events_cli.main([str(tmp_path), "--kind", "lease", "--json",
+                          "--out", out_path,
+                          "--chrome-out", chrome])
+    assert rc == 0
+    printed = [json.loads(ln) for ln in
+               capsys.readouterr().out.strip().splitlines()]
+    assert _kinds(printed) == ["lease.grant"]
+    # --out holds the FULL merged timeline, filter notwithstanding
+    merged = ev.read_log(out_path, include_rotated=False)
+    assert len(merged) == 3
+    with open(chrome) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"]
+    # human (non-json) mode renders the canonical line format
+    rc = events_cli.main([str(tmp_path), "--source", "supervisor"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "supervisor#0" in out and "supervisor.spawn" in out
+
+
+def test_cli_why_resolves_planted_regression(tmp_path, capsys):
+    from kme_tpu.telemetry.tsdb import TSDB
+
+    t_event = 1000.0
+    store = str(tmp_path / "tsdb")
+    db = TSDB(store, source="serve")
+    db.append_snapshot(
+        {"latencies": {"lat_e2e": {"p99_ms": 5.0}}}, 1,
+        ts_us=int((t_event - 3.0) * 1e6))
+    db.append_snapshot(
+        {"latencies": {"lat_e2e": {"p99_ms": 50.0}},
+         "gauges": {"steady_gauge": 7.0}}, 2,
+        ts_us=int((t_event + 3.0) * 1e6))
+    db.close()
+    log = ev.open_log(str(tmp_path), "serve", clock=lambda: t_event)
+    log.emit("overload.transition", severity="warn",
+             from_state="admit", to_state="shed")
+    log.close()
+    rc = events_cli.main([str(tmp_path), "--why", "serve:0",
+                          "--store", store, "--window", "5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # the planted latency jump is attributed as the top delta
+    assert "overload.transition" in out
+    assert "lat_e2e.p99_ms" in out
+    assert "5 -> 50" in out
+    # a bare-kind ref resolves too, and a miss exits non-zero
+    assert events_cli.main([str(tmp_path), "--why", "overload",
+                            "--store", store]) == 0
+    capsys.readouterr()
+    assert events_cli.main([str(tmp_path), "--why", "nope:77",
+                            "--store", store]) == 2
